@@ -1,0 +1,22 @@
+"""esac_tpu — TPU-native expert-sample-consensus camera re-localization.
+
+A from-scratch JAX/Flax/XLA rebuild of the capabilities of vislearn/esac
+(ICCV 2019, "Expert Sample Consensus Applied to Camera Re-Localization").
+The reference implements its hypothesis loop as a CPU-bound C++/OpenMP/OpenCV
+torch extension (see SURVEY.md §2 #3-7; the reference mount was empty, so
+paths there are reconstructed, not verified); here the whole pipeline —
+scene-coordinate regression, 4-point PnP, soft-inlier scoring, selection and
+refinement — is pure JAX, `vmap`'d over hypotheses and compiled by XLA into a
+single TPU dispatch.
+
+Subpackages (landing incrementally; only those importable in this tree exist)
+-----------
+- ``geometry``  : rotations, camera projection, pose metrics, differentiable PnP
+- ``ransac``    : the vmap'd hypothesis kernel (sample → solve → score → refine)
+- ``models``    : Flax expert FCN + gating network
+- ``parallel``  : device-mesh sharding of expert ensembles, pose all-reduce
+- ``data``      : synthetic scenes + dataset loaders (7-Scenes / 12-Scenes / Aachen)
+- ``train``     : three-stage training (expert init, gating init, end-to-end)
+"""
+
+__version__ = "0.1.0"

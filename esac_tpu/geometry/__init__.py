@@ -1,0 +1,37 @@
+"""Pure-JAX geometry core: rotations, projection, pose errors, PnP.
+
+Everything here is functional, static-shaped, and safe under ``jax.vmap`` /
+``jax.jit`` — the building blocks of the hypothesis kernel.
+"""
+
+from esac_tpu.geometry.rotations import (
+    skew,
+    rodrigues,
+    so3_log,
+    rotation_angle_deg,
+    rot_error_deg,
+)
+from esac_tpu.geometry.camera import (
+    transform_points,
+    project,
+    reprojection_errors,
+    pose_errors,
+)
+from esac_tpu.geometry.pnp import (
+    solve_pnp_minimal,
+    refine_pose_gn,
+)
+
+__all__ = [
+    "skew",
+    "rodrigues",
+    "so3_log",
+    "rotation_angle_deg",
+    "rot_error_deg",
+    "transform_points",
+    "project",
+    "reprojection_errors",
+    "pose_errors",
+    "solve_pnp_minimal",
+    "refine_pose_gn",
+]

@@ -1,0 +1,233 @@
+"""Differentiable, vmap-safe PnP: minimal 4-point solve + Gauss-Newton refine.
+
+The reference solves every minimal set with ``cv::solvePnP`` (P3P + iterative
+refinement) inside an OpenMP loop, and differentiates the refined pose by
+central finite differences (SURVEY.md §2 #3-4, §3.5; reference mount empty so
+paths are reconstructed).  Neither maps to a TPU: OpenCV is host code and
+finite differences re-run the solver O(dim) times.
+
+The TPU-native design here is different end to end:
+
+1.  **Minimal solve (4 points)** — algebraic P3P on the first three
+    correspondences (Grunert's quartic, solved in closed form by the
+    branchless complex Ferrari solver in ``quartic.py`` since XLA-on-TPU has
+    no nonsymmetric eig), all four root branches evaluated in parallel and
+    disambiguated by the 4th point's reprojection error, pose recovered per
+    branch with a differentiable Kabsch/Procrustes SVD, then polished with a
+    few Gauss-Newton steps on reprojection error.
+2.  **Refinement (N points, soft weights)** — weighted Gauss-Newton on the
+    6-DoF axis-angle pose; fixed iteration counts, LM damping.  Because every
+    step is a total, differentiable function, ``jax.grad`` replaces the
+    reference's central-difference machinery for free.
+
+Everything has static shapes and fixed loop lengths, so the whole solver
+``vmap``s over thousands of hypotheses and compiles into one XLA program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from esac_tpu.geometry.camera import MIN_DEPTH, reprojection_errors
+from esac_tpu.geometry.quartic import solve_quartic
+from esac_tpu.geometry.rotations import rodrigues, so3_log
+from esac_tpu.utils.precision import hmm
+
+# Pair indices of the 6 unordered pairs of 4 points.
+_PAIR_I = jnp.array([0, 0, 0, 1, 1, 2])
+_PAIR_J = jnp.array([1, 2, 3, 2, 3, 3])
+
+
+def bearings(x2d: jnp.ndarray, f: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
+    """Pixels -> unit bearing vectors in the camera frame. (..., N, 2) -> (..., N, 3)."""
+    xy = (x2d - c) / f
+    ones = jnp.ones_like(xy[..., :1])
+    rays = jnp.concatenate([xy, ones], axis=-1)
+    return rays / jnp.linalg.norm(rays, axis=-1, keepdims=True)
+
+
+def _p3p_depths(b3: jnp.ndarray, X3: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Algebraic P3P (Grunert): depths of 3 rays for up to 4 solutions.
+
+    b3: (3, 3) unit bearings, X3: (3, 3) scene points.
+    Returns (depths (4, 3), penalty (4,)) — penalty is 0 for clean real
+    positive-depth solutions and grows for complex/negative/degenerate ones,
+    so a downstream argmin ignores invalid branches without any control flow.
+
+    Derivation (classic triangle-side elimination): with depths s1, s2=u*s1,
+    s3=v*s1, side lengths a=|X2-X3|, b=|X1-X3|, c=|X1-X2| and ray cosines
+    ca=b2.b3, cb=b1.b3, cg=b1.b2, eliminating u between the two distance
+    equations leaves u = -E(v)/D(v) and a quartic Q(v) = 0 with
+    D = 2 b^2 (ca v - cg),   E = (w - b^2) v^2 - 2 w cb v + (b^2 + w),
+    G = -c^2 v^2 + 2 c^2 cb v + (b^2 - c^2),   w = a^2 - c^2,
+    Q = b^2 E^2 + 2 b^2 cg E D + G D^2.
+    """
+    ca = jnp.dot(b3[1], b3[2])
+    cb = jnp.dot(b3[0], b3[2])
+    cg = jnp.dot(b3[0], b3[1])
+    asq = jnp.sum((X3[1] - X3[2]) ** 2)
+    bsq = jnp.sum((X3[0] - X3[2]) ** 2)
+    csq = jnp.sum((X3[0] - X3[1]) ** 2)
+    w = asq - csq
+
+    d1, d0 = 2.0 * bsq * ca, -2.0 * bsq * cg
+    e2, e1, e0 = w - bsq, -2.0 * w * cb, bsq + w
+    g2, g1, g0 = -csq, 2.0 * csq * cb, bsq - csq
+
+    # Polynomial products by explicit convolution (highest degree first).
+    E2 = jnp.array(
+        [e2 * e2, 2 * e2 * e1, 2 * e2 * e0 + e1 * e1, 2 * e1 * e0, e0 * e0]
+    )
+    ED = jnp.array([0.0, e2 * d1, e2 * d0 + e1 * d1, e1 * d0 + e0 * d1, e0 * d0])
+    A2, B2, C2 = d1 * d1, 2 * d1 * d0, d0 * d0
+    GD2 = jnp.array(
+        [g2 * A2, g2 * B2 + g1 * A2, g2 * C2 + g1 * B2 + g0 * A2, g1 * C2 + g0 * B2, g0 * C2]
+    )
+    Q = bsq * E2 + 2.0 * bsq * cg * ED + GD2
+    # No pre-normalization here: solve_quartic scales internally, and stacking
+    # two divisions lets XLA fuse them into one whose combined denominator
+    # underflows float32 for all-zero Q (0/0 = NaN under jit, fine in eager).
+
+    roots = solve_quartic(Q)  # (4,) complex
+    v = jnp.real(roots)
+    imag_pen = jnp.abs(jnp.imag(roots))
+
+    Dv = d1 * v + d0
+    Ev = (e2 * v + e1) * v + e0
+    u = -Ev / jnp.where(jnp.abs(Dv) < 1e-9, 1e-9, Dv)
+    denom = 1.0 + v * v - 2.0 * v * cb
+    s1 = jnp.sqrt(bsq / jnp.maximum(denom, 1e-9))
+    depths = jnp.stack([s1, u * s1, v * s1], axis=-1)  # (4 roots, 3 points)
+
+    penalty = (
+        imag_pen
+        + 1e3 * jnp.sum(jnp.maximum(MIN_DEPTH - depths, 0.0), axis=-1)
+        + 1e3 * (denom < 1e-9).astype(v.dtype)
+    )
+    return depths, penalty
+
+
+def _kabsch(X: jnp.ndarray, Y: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Rigid pose (R, t) with Y ~= R X + t, by Procrustes SVD. X, Y: (N, 3)."""
+    Xm = X.mean(axis=0)
+    Ym = Y.mean(axis=0)
+    H = hmm((X - Xm).T, Y - Ym)
+    U, _, Vt = jnp.linalg.svd(H)
+    # Proper rotation: flip the last singular direction if det < 0.
+    det = jnp.linalg.det(hmm(Vt.T, U.T))
+    S = jnp.diag(jnp.array([1.0, 1.0, 1.0], dtype=X.dtype)).at[2, 2].set(det)
+    R = hmm(hmm(Vt.T, S), U.T)
+    t = Ym - hmm(R, Xm[:, None])[:, 0]
+    return R, t
+
+
+def _pose_residuals(
+    p: jnp.ndarray,
+    X: jnp.ndarray,
+    x2d: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+) -> jnp.ndarray:
+    """Flattened weighted-less reprojection residuals for a 6-vector pose."""
+    R = rodrigues(p[:3])
+    Y = hmm(X, R.T) + p[3:]
+    z = jnp.maximum(Y[:, 2:3], MIN_DEPTH)
+    xp = Y[:, :2] / z * f + c
+    return (xp - x2d).reshape(-1)
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def refine_pose_gn(
+    rvec: jnp.ndarray,
+    tvec: jnp.ndarray,
+    X: jnp.ndarray,
+    x2d: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    weights: jnp.ndarray | None = None,
+    iters: int = 5,
+    damping: float = 1e-4,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Weighted Gauss-Newton on the 6-DoF pose, fixed iterations.
+
+    Replaces the reference's iterative cv::solvePnP refinement loop
+    (SURVEY.md §3.5 "refine winner") with a differentiable, fixed-length LM.
+    ``weights`` is (N,) per-point (soft-inlier) weights; None = uniform.
+    """
+    p0 = jnp.concatenate([rvec, tvec])
+    w = jnp.ones(X.shape[0], dtype=X.dtype) if weights is None else weights
+    # Each point contributes two residuals (u, v).
+    w2 = jnp.repeat(w, 2)
+    jac = jax.jacfwd(_pose_residuals)
+
+    def step(p, _):
+        r = _pose_residuals(p, X, x2d, f, c)
+        J = jac(p, X, x2d, f, c)  # (2N, 6)
+        Jw = J * w2[:, None]
+        A = hmm(J.T, Jw)
+        mu = damping * (jnp.trace(A) / 6.0 + 1e-6)
+        g = hmm(Jw.T, r[:, None])[:, 0]
+        delta = jnp.linalg.solve(A + mu * jnp.eye(6, dtype=A.dtype), g)
+        return p - delta, None
+
+    p, _ = jax.lax.scan(step, p0, None, length=iters)
+    return p[:3], p[3:]
+
+
+@partial(jax.jit, static_argnames=("polish_iters",))
+def solve_pnp_minimal(
+    X4: jnp.ndarray,
+    x4: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    polish_iters: int = 3,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Minimal 4-point PnP. X4: (4, 3) scene points, x4: (4, 2) pixels.
+
+    Returns (rvec, tvec) with scene->camera convention Y = R X + t.
+    Degenerate samples (collinear points, coincident pixels) produce *some*
+    finite pose; RANSAC scoring rejects them, mirroring the reference's
+    retry-on-bad-sample policy without data-dependent control flow.
+    """
+    b = bearings(x4, f, c)
+    depths, penalty = _p3p_depths(b[:3], X4[:3])  # (4, 3), (4,)
+
+    def candidate(lam3):
+        Y3 = lam3[:, None] * b[:3]
+        R, t = _kabsch(X4[:3], Y3)
+        # Disambiguate with the 4th correspondence.
+        err4 = reprojection_errors(R, t, X4[3:4], x4[3:4], f, c)[0]
+        return R, t, err4
+
+    Rs, ts, err4s = jax.vmap(candidate)(depths)
+    # A NaN branch (pathological geometry) must never win the argmin.
+    cost = err4s + penalty
+    best = jnp.argmin(jnp.where(jnp.isnan(cost), jnp.inf, cost))
+    rvec = so3_log(Rs[best])
+    t = ts[best]
+    rvec, t = refine_pose_gn(
+        rvec, t, X4, x4, f, c, weights=None, iters=polish_iters
+    )
+    return rvec, t
+
+
+def pnp_success(
+    rvec: jnp.ndarray,
+    tvec: jnp.ndarray,
+    X4: jnp.ndarray,
+    x4: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    threshold: float,
+) -> jnp.ndarray:
+    """Did the minimal solve fit its own 4 points within `threshold` px?
+
+    The reference accepts a hypothesis only if the 4 sampled correspondences
+    reproject within threshold (SURVEY.md §3.5); we compute the same predicate
+    as a differentiable-free boolean for masking/diagnostics.
+    """
+    errs = reprojection_errors(rodrigues(rvec), tvec, X4, x4, f, c)
+    return jnp.all(errs < threshold)

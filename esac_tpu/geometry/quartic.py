@@ -1,0 +1,121 @@
+"""Closed-form cubic/quartic root solvers in complex arithmetic.
+
+TPU XLA has no nonsymmetric eigendecomposition, so the companion-matrix trick
+for polynomial roots is unavailable; Cardano/Ferrari in complex64 is fully
+branchless, vmap-safe, and differentiable away from root collisions.  Used by
+the algebraic P3P minimal solver (the reference gets its roots from OpenCV's
+``solvePnP`` P3P path on the host, SURVEY.md §3.5).
+
+Precision note: complex64 root extraction is good to ~1e-3 relative; the PnP
+pipeline always polishes with Gauss-Newton afterwards, which removes the
+residual error.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_EPS = 1e-12
+
+
+def _cbrt(z: jnp.ndarray) -> jnp.ndarray:
+    """Principal complex cube root, total at 0."""
+    mag = jnp.abs(z)
+    safe = jnp.where(mag < _EPS, 1.0 + 0j, z)
+    out = jnp.exp(jnp.log(safe) / 3.0)
+    return jnp.where(mag < _EPS, 0.0 + 0j, out)
+
+
+def solve_cubic(B: jnp.ndarray, C: jnp.ndarray, D: jnp.ndarray) -> jnp.ndarray:
+    """Roots of m^3 + B m^2 + C m + D. Scalars (complex or real) -> (3,) complex."""
+    B = B.astype(jnp.complex64)
+    C = C.astype(jnp.complex64)
+    D = D.astype(jnp.complex64)
+    P = C - B * B / 3.0
+    Q = 2.0 * B**3 / 27.0 - B * C / 3.0 + D
+    S = jnp.sqrt((Q / 2.0) ** 2 + (P / 3.0) ** 3)
+    z1 = -Q / 2.0 + S
+    z2 = -Q / 2.0 - S
+    # Use the larger branch for the cube root to avoid cancellation.
+    z = jnp.where(jnp.abs(z1) >= jnp.abs(z2), z1, z2)
+    U = _cbrt(z)
+    W = jnp.where(jnp.abs(U) < _EPS, 0.0 + 0j, -P / (3.0 * jnp.where(jnp.abs(U) < _EPS, 1.0, U)))
+    omega = jnp.exp(2j * jnp.pi / 3.0).astype(jnp.complex64)
+    ks = jnp.array([1.0 + 0j, omega, omega**2])
+    roots = ks * U + jnp.conj(ks) * W - B / 3.0
+    return roots
+
+
+def _ferrari(a3: jnp.ndarray, a2: jnp.ndarray, a1: jnp.ndarray, a0: jnp.ndarray) -> jnp.ndarray:
+    """Roots of the monic quartic v^4 + a3 v^3 + a2 v^2 + a1 v + a0 (complex)."""
+    # Depressed quartic y^4 + p y^2 + q y + r with v = y - a3/4.
+    p = a2 - 3.0 * a3 * a3 / 8.0
+    q = a1 - a3 * a2 / 2.0 + a3**3 / 8.0
+    r = a0 - a3 * a1 / 4.0 + a3 * a3 * a2 / 16.0 - 3.0 * a3**4 / 256.0
+
+    # Resolvent cubic m^3 + p m^2 + (p^2 - 4r)/4 m - q^2/8 = 0.
+    m_roots = solve_cubic(p, (p * p - 4.0 * r) / 4.0, -q * q / 8.0)
+    # Largest |m| keeps s = sqrt(2m) well away from zero (m=0 happens iff q=0,
+    # where the biquadratic factorization is exact anyway).
+    m = m_roots[jnp.argmax(jnp.abs(m_roots))]
+    s = jnp.sqrt(2.0 * m)
+    s_safe = jnp.where(jnp.abs(s) < _EPS, 1.0 + 0j, s)
+    qs = jnp.where(jnp.abs(s) < _EPS, 0.0 + 0j, q / (2.0 * s_safe))
+
+    t1 = p / 2.0 + m - qs
+    t2 = p / 2.0 + m + qs
+    d1 = jnp.sqrt(s * s - 4.0 * t1)
+    d2 = jnp.sqrt(s * s - 4.0 * t2)
+    y = jnp.stack(
+        [
+            (-s + d1) / 2.0,
+            (-s - d1) / 2.0,
+            (s + d2) / 2.0,
+            (s - d2) / 2.0,
+        ]
+    )
+    return y - a3 / 4.0
+
+
+def solve_quartic(coeffs: jnp.ndarray) -> jnp.ndarray:
+    """Roots of q4 v^4 + q3 v^3 + q2 v^2 + q1 v + q0.
+
+    coeffs: (5,) [q4, q3, q2, q1, q0] real. Returns (4,) complex roots.
+
+    Stability: Ferrari needs a healthy leading coefficient.  When |q0| > |q4|
+    the *reversed* polynomial (whose roots are 1/v) is better conditioned, so
+    both ends are solved and the better-conditioned branch is selected —
+    branchless, and total even for cubic-degenerate quartics (q4 -> 0), whose
+    "root at infinity" comes back as a clamped large value that downstream
+    penalties reject.  A relative floor keeps the untaken branch finite so no
+    NaN can leak through ``where``.
+    """
+    # 1e-25 (not smaller): this epsilon can get multiplied into a caller's
+    # denominator if XLA fuses nested divisions; it must stay comfortably
+    # above float32 underflow so the fused denominator never hits zero.
+    scale = jnp.max(jnp.abs(coeffs)) + 1e-25
+    c = (coeffs / scale).astype(jnp.float32)
+    q4, q0 = c[0], c[4]
+
+    def lead_safe(q):
+        # Floor at 1e-2 of the max coefficient: keeps a3 <= 100 so Ferrari's
+        # worst intermediate (~|a3|^6 in the resolvent) stays in float32 range.
+        return jnp.where(jnp.abs(q) < 1e-2, jnp.where(q < 0, -1e-2, 1e-2), q)
+
+    q4s = lead_safe(q4)
+    q0s = lead_safe(q0)
+    fwd = _ferrari(
+        (c[1] / q4s).astype(jnp.complex64),
+        (c[2] / q4s).astype(jnp.complex64),
+        (c[3] / q4s).astype(jnp.complex64),
+        (c[4] / q4s).astype(jnp.complex64),
+    )
+    rev_w = _ferrari(
+        (c[3] / q0s).astype(jnp.complex64),
+        (c[2] / q0s).astype(jnp.complex64),
+        (c[1] / q0s).astype(jnp.complex64),
+        (c[0] / q0s).astype(jnp.complex64),
+    )
+    w_safe = jnp.where(jnp.abs(rev_w) < 1e-8, 1e-8 + 0j, rev_w)
+    rev = 1.0 / w_safe
+    return jnp.where(jnp.abs(q4) >= jnp.abs(q0), fwd, rev)

@@ -1,0 +1,113 @@
+"""Rotation utilities: axis-angle (Rodrigues) <-> matrix, angle errors.
+
+The reference uses ``cv::Rodrigues`` inside its C++ extension for every PnP
+solve and pose-error computation (SURVEY.md §2 #3, §3.5; reference mount was
+empty so no file:line is citable).  Here the same math is written branchless
+so it is differentiable and safe under ``vmap``: the small-angle limit is
+handled with a Taylor-series blend instead of an ``if``.
+
+All functions broadcast over leading batch dimensions.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from esac_tpu.utils.precision import hmm
+
+# Below this angle (radians) the sin(x)/x style factors switch to their
+# Taylor expansions to avoid 0/0.
+_SMALL_ANGLE = 1e-6
+
+
+def skew(v: jnp.ndarray) -> jnp.ndarray:
+    """Skew-symmetric cross-product matrix. (..., 3) -> (..., 3, 3)."""
+    x, y, z = v[..., 0], v[..., 1], v[..., 2]
+    zero = jnp.zeros_like(x)
+    return jnp.stack(
+        [
+            jnp.stack([zero, -z, y], axis=-1),
+            jnp.stack([z, zero, -x], axis=-1),
+            jnp.stack([-y, x, zero], axis=-1),
+        ],
+        axis=-2,
+    )
+
+
+def rodrigues(rvec: jnp.ndarray) -> jnp.ndarray:
+    """Axis-angle vector -> rotation matrix. (..., 3) -> (..., 3, 3).
+
+    R = I + a K + b K^2 with K = skew(rvec), a = sin(t)/t, b = (1-cos(t))/t^2.
+    Branchless small-angle handling: for t -> 0, a -> 1 - t^2/6 and
+    b -> 1/2 - t^2/24.
+    """
+    theta2 = jnp.sum(rvec * rvec, axis=-1)
+    theta = jnp.sqrt(theta2 + 1e-32)
+    small = theta < _SMALL_ANGLE
+    # Safe denominators: where `small`, the Taylor branch is used, so the
+    # division result is discarded, but it must not be NaN.
+    safe_theta = jnp.where(small, 1.0, theta)
+    safe_theta2 = jnp.where(small, 1.0, theta2)
+    a = jnp.where(small, 1.0 - theta2 / 6.0, jnp.sin(theta) / safe_theta)
+    b = jnp.where(small, 0.5 - theta2 / 24.0, (1.0 - jnp.cos(theta)) / safe_theta2)
+    K = skew(rvec)
+    eye = jnp.broadcast_to(jnp.eye(3, dtype=rvec.dtype), K.shape)
+    return eye + a[..., None, None] * K + b[..., None, None] * hmm(K, K)
+
+
+def so3_log(R: jnp.ndarray) -> jnp.ndarray:
+    """Rotation matrix -> axis-angle vector. (..., 3, 3) -> (..., 3).
+
+    Uses the skew-part formula away from 0 and pi; near pi falls back to the
+    diagonal formula for the axis.  Branchless via ``where``.
+    """
+    trace = R[..., 0, 0] + R[..., 1, 1] + R[..., 2, 2]
+    cos_t = jnp.clip((trace - 1.0) * 0.5, -1.0, 1.0)
+    theta = jnp.arccos(cos_t)
+    # Vector from the skew-symmetric part: (R - R^T)/2 = sin(t) * skew(axis).
+    w = jnp.stack(
+        [
+            R[..., 2, 1] - R[..., 1, 2],
+            R[..., 0, 2] - R[..., 2, 0],
+            R[..., 1, 0] - R[..., 0, 1],
+        ],
+        axis=-1,
+    )
+    sin_t = jnp.sin(theta)
+    small = sin_t < _SMALL_ANGLE
+    near_pi = cos_t < -0.999
+    safe_sin = jnp.where(small, 1.0, sin_t)
+    axis_generic = w / (2.0 * safe_sin[..., None])
+    # Near pi: R + R^T = 2 cos(t) I + 2 (1 - cos(t)) a a^T, so the outer
+    # product a a^T is recoverable with a well-conditioned denominator
+    # (1 - cos(t) ~ 2).  Take its largest column as +-a, then orient the sign
+    # with the skew part w = 2 sin(t) a (sin(t) > 0 for t < pi).
+    denom_pi = 2.0 * (1.0 - cos_t)
+    safe_denom_pi = jnp.where(near_pi, denom_pi, 1.0)
+    eye = jnp.broadcast_to(jnp.eye(3, dtype=R.dtype), R.shape)
+    M = (R + jnp.swapaxes(R, -1, -2) - 2.0 * cos_t[..., None, None] * eye) / (
+        safe_denom_pi[..., None, None]
+    )
+    diag = jnp.stack([M[..., 0, 0], M[..., 1, 1], M[..., 2, 2]], axis=-1)
+    k = jnp.argmax(diag, axis=-1)
+    col = jnp.take_along_axis(M, k[..., None, None], axis=-1)[..., 0]
+    axis_pi = col / (jnp.linalg.norm(col, axis=-1, keepdims=True) + 1e-12)
+    orient = jnp.sum(w * axis_pi, axis=-1, keepdims=True)
+    axis_pi = axis_pi * jnp.where(orient < 0, -1.0, 1.0)
+    axis = jnp.where(near_pi[..., None], axis_pi, axis_generic)
+    # At theta ~ 0 the axis is arbitrary; rvec -> 0 regardless.
+    small_total = theta < _SMALL_ANGLE
+    rvec = jnp.where(small_total[..., None], w * 0.5, axis * theta[..., None])
+    return rvec
+
+
+def rotation_angle_deg(R: jnp.ndarray) -> jnp.ndarray:
+    """Rotation angle of R in degrees. (..., 3, 3) -> (...)."""
+    trace = R[..., 0, 0] + R[..., 1, 1] + R[..., 2, 2]
+    cos_t = jnp.clip((trace - 1.0) * 0.5, -1.0, 1.0)
+    return jnp.degrees(jnp.arccos(cos_t))
+
+
+def rot_error_deg(R1: jnp.ndarray, R2: jnp.ndarray) -> jnp.ndarray:
+    """Relative rotation angle between two rotations, in degrees."""
+    return rotation_angle_deg(hmm(R1, jnp.swapaxes(R2, -1, -2)))

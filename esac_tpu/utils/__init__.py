@@ -1,0 +1,3 @@
+from esac_tpu.utils.precision import hmm, heinsum
+
+__all__ = ["hmm", "heinsum"]

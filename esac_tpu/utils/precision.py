@@ -1,0 +1,25 @@
+"""Precision-pinned matmul helpers for the geometry core.
+
+TPU MXU matmuls default to bfloat16 inputs, which is right for the big CNN
+convolutions but catastrophically wrong for 3x3 rotation algebra (1e-3 entry
+error -> degrees of rotation error).  All geometry-core contractions go
+through these helpers, which pin ``Precision.HIGHEST`` (full fp32 on TPU).
+The tensors involved are tiny, so the cost is nil.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_HIGH = jax.lax.Precision.HIGHEST
+
+
+def hmm(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """matmul at HIGHEST precision."""
+    return jnp.matmul(a, b, precision=_HIGH)
+
+
+def heinsum(spec: str, *args: jnp.ndarray) -> jnp.ndarray:
+    """einsum at HIGHEST precision."""
+    return jnp.einsum(spec, *args, precision=_HIGH)

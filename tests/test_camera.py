@@ -1,0 +1,66 @@
+"""Unit tests for projection / reprojection / pose-error math."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esac_tpu.geometry import (
+    pose_errors,
+    project,
+    reprojection_errors,
+    rodrigues,
+    transform_points,
+)
+
+
+F = jnp.float32(525.0)
+C = jnp.array([320.0, 240.0])
+
+
+def test_project_center():
+    # A point on the optical axis lands on the principal point.
+    Y = jnp.array([[0.0, 0.0, 2.0]])
+    np.testing.assert_allclose(project(Y, F, C), C[None], atol=1e-6)
+
+
+def test_project_known_offset():
+    Y = jnp.array([[1.0, 0.0, 2.0]])
+    expected = jnp.array([[320.0 + 525.0 / 2.0, 240.0]])
+    np.testing.assert_allclose(project(Y, F, C), expected, atol=1e-5)
+
+
+def test_reprojection_zero_for_exact_pose():
+    key = jax.random.key(0)
+    rvec = jnp.array([0.1, -0.2, 0.05])
+    t = jnp.array([0.3, -0.1, 0.2])
+    R = rodrigues(rvec)
+    X = jax.random.uniform(key, (50, 3), minval=-1.0, maxval=1.0) + jnp.array([0.0, 0.0, 4.0])
+    # Scene points placed so all are in front of the camera after transform.
+    x2d = project(transform_points(R, t, X), F, C)
+    errs = reprojection_errors(R, t, X, x2d, F, C)
+    np.testing.assert_allclose(errs, jnp.zeros(50), atol=1e-3)
+
+
+def test_behind_camera_penalized():
+    R = jnp.eye(3)
+    t = jnp.zeros(3)
+    X = jnp.array([[0.0, 0.0, -2.0]])
+    errs = reprojection_errors(R, t, X, C[None], F, C)
+    assert errs[0] > 999.0
+
+
+def test_pose_errors_identity():
+    R = rodrigues(jnp.array([0.2, 0.1, -0.3]))
+    t = jnp.array([1.0, 2.0, 3.0])
+    r_err, t_err = pose_errors(R, t, R, t)
+    assert r_err == pytest.approx(0.0, abs=1e-3)
+    assert t_err == pytest.approx(0.0, abs=1e-5)
+
+
+def test_pose_errors_translation_is_camera_center_distance():
+    R = jnp.eye(3)
+    t1 = jnp.array([0.0, 0.0, 0.0])
+    t2 = jnp.array([0.05, 0.0, 0.0])
+    _, t_err = pose_errors(R, t1, R, t2)
+    assert t_err == pytest.approx(0.05, abs=1e-6)

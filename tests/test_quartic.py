@@ -1,0 +1,71 @@
+"""Quartic/cubic solver vs numpy.roots."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esac_tpu.geometry.quartic import solve_cubic, solve_quartic
+
+
+def _match_roots(got, expected, tol):
+    # Greedy nearest-neighbour matching: sorting complex conjugate pairs by
+    # (real, imag) mispairs them when float noise perturbs equal real parts.
+    got = list(np.asarray(got))
+    for e in expected:
+        i = int(np.argmin([abs(g - e) for g in got]))
+        g = got.pop(i)
+        assert abs(g - e) < tol, f"{g} vs {e}"
+
+
+def test_cubic_known():
+    # (m-1)(m-2)(m-3) = m^3 - 6m^2 + 11m - 6
+    roots = solve_cubic(jnp.complex64(-6), jnp.complex64(11), jnp.complex64(-6))
+    _match_roots(roots, [1, 2, 3], 1e-3)
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_quartic_random_real_roots(seed):
+    rng = np.random.default_rng(seed)
+    true = rng.uniform(-3, 3, size=4)
+    coeffs = np.poly(true)  # leading 1
+    roots = solve_quartic(jnp.array(coeffs, dtype=jnp.float32))
+    # 5e-2: random quartics occasionally have near-double roots, whose
+    # conditioning is ~sqrt(machine eps) in float32.
+    _match_roots(roots, true, 5e-2)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_quartic_complex_pairs(seed):
+    rng = np.random.default_rng(100 + seed)
+    # Two real roots + one complex-conjugate pair.
+    re = rng.uniform(-2, 2, size=2)
+    a, b = rng.uniform(-2, 2), rng.uniform(0.3, 2)
+    true = [re[0], re[1], complex(a, b), complex(a, -b)]
+    coeffs = np.real(np.poly(true))
+    roots = solve_quartic(jnp.array(coeffs, dtype=jnp.float32))
+    _match_roots(roots, true, 3e-2)
+
+
+def test_quartic_biquadratic():
+    # y^4 - 5y^2 + 4 -> roots ±1, ±2 (q = 0 path).
+    roots = solve_quartic(jnp.array([1.0, 0.0, -5.0, 0.0, 4.0]))
+    _match_roots(roots, [-2, -1, 1, 2], 1e-2)
+
+
+def test_quartic_vmaps():
+    rng = np.random.default_rng(7)
+    polys = np.stack([np.poly(rng.uniform(-2, 2, 4)) for _ in range(32)]).astype(np.float32)
+    roots = jax.jit(jax.vmap(solve_quartic))(jnp.array(polys))
+    assert roots.shape == (32, 4)
+    assert np.all(np.isfinite(np.asarray(roots).view(np.float32)))
+
+
+def test_quartic_degenerate_leading_coeff():
+    # q4 = 0 (cubic in disguise): (v-1)(v-2)(v-3). Must stay finite and keep
+    # the three true roots; the fourth (spurious far) root is fine.
+    roots = np.asarray(solve_quartic(jnp.array([0.0, 1.0, -6.0, 11.0, -6.0])))
+    assert np.all(np.isfinite(roots.view(np.float32)))
+    real = sorted(r.real for r in roots if abs(r.imag) < 0.1)
+    for want in (1.0, 2.0, 3.0):
+        assert any(abs(r - want) < 0.05 for r in real), (want, real)

@@ -1,0 +1,79 @@
+"""Unit tests for axis-angle / rotation-matrix math."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esac_tpu.geometry import rodrigues, rot_error_deg, rotation_angle_deg, skew, so3_log
+
+
+def random_rvecs(key, n, max_angle=np.pi - 0.05):
+    k1, k2 = jax.random.split(key)
+    axes = jax.random.normal(k1, (n, 3))
+    axes = axes / jnp.linalg.norm(axes, axis=-1, keepdims=True)
+    angles = jax.random.uniform(k2, (n, 1), minval=1e-4, maxval=max_angle)
+    return axes * angles
+
+
+def test_skew_cross_product():
+    a = jnp.array([1.0, 2.0, 3.0])
+    b = jnp.array([-0.5, 0.7, 2.0])
+    np.testing.assert_allclose(skew(a) @ b, jnp.cross(a, b), atol=1e-6)
+
+
+def test_rodrigues_is_rotation():
+    rvecs = random_rvecs(jax.random.key(0), 64)
+    R = rodrigues(rvecs)
+    eye = jnp.eye(3)
+    np.testing.assert_allclose(R @ jnp.swapaxes(R, -1, -2), jnp.broadcast_to(eye, R.shape), atol=1e-5)
+    np.testing.assert_allclose(jnp.linalg.det(R), jnp.ones(64), atol=1e-5)
+
+
+def test_rodrigues_known_90deg():
+    # 90 deg about z: x -> y.
+    R = rodrigues(jnp.array([0.0, 0.0, np.pi / 2]))
+    np.testing.assert_allclose(R @ jnp.array([1.0, 0.0, 0.0]), jnp.array([0.0, 1.0, 0.0]), atol=1e-6)
+
+
+def test_rodrigues_small_angle_stable():
+    tiny = jnp.array([1e-9, -1e-9, 1e-9])
+    R = rodrigues(tiny)
+    assert jnp.all(jnp.isfinite(R))
+    np.testing.assert_allclose(R, jnp.eye(3), atol=1e-7)
+    # Gradient must be finite at ~zero angle too.
+    g = jax.grad(lambda r: jnp.sum(rodrigues(r)))(tiny)
+    assert jnp.all(jnp.isfinite(g))
+
+
+def test_log_roundtrip():
+    rvecs = random_rvecs(jax.random.key(1), 128)
+    back = so3_log(rodrigues(rvecs))
+    np.testing.assert_allclose(back, rvecs, atol=1e-3)
+
+
+def test_log_near_pi():
+    rvecs = random_rvecs(jax.random.key(2), 32, max_angle=np.pi - 1e-4)
+    # Scale all to an angle of ~pi - 1e-3.
+    rvecs = rvecs / jnp.linalg.norm(rvecs, axis=-1, keepdims=True) * (np.pi - 1e-3)
+    R = rodrigues(rvecs)
+    R2 = rodrigues(so3_log(R))
+    np.testing.assert_allclose(rot_error_deg(R, R2), jnp.zeros(32), atol=0.1)
+
+
+def test_rotation_angle():
+    rv = jnp.array([0.0, 0.3, 0.0])
+    assert rotation_angle_deg(rodrigues(rv)) == pytest.approx(np.degrees(0.3), abs=1e-3)
+
+
+def test_rot_error_composition():
+    a = jnp.array([0.1, 0.0, 0.0])
+    b = jnp.array([0.25, 0.0, 0.0])
+    err = rot_error_deg(rodrigues(a), rodrigues(b))
+    assert err == pytest.approx(np.degrees(0.15), abs=1e-3)
+
+
+def test_vmap_jit_compose():
+    rvecs = random_rvecs(jax.random.key(3), 16)
+    R_vmapped = jax.jit(jax.vmap(rodrigues))(rvecs)
+    np.testing.assert_allclose(R_vmapped, rodrigues(rvecs), atol=1e-6)
