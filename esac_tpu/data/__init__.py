@@ -1,0 +1,26 @@
+"""Data: synthetic scenes for tests/benchmarks + dataset loaders.
+
+The reference ships one-shot setup scripts for 7-Scenes / 12-Scenes / Aachen
+(SURVEY.md §2 #13-15); those datasets cannot be downloaded in this
+environment, so the loaders accept the standard on-disk layouts while the
+synthetic box-scene provides a fully self-contained renderer for unit tests,
+end-to-end training tests and benchmarks.
+"""
+
+from esac_tpu.data.synthetic import (
+    CAMERA_F,
+    CAMERA_C,
+    make_correspondence_frame,
+    output_pixel_grid,
+    render_box_scene,
+    random_poses_in_box,
+)
+
+__all__ = [
+    "CAMERA_F",
+    "CAMERA_C",
+    "make_correspondence_frame",
+    "output_pixel_grid",
+    "render_box_scene",
+    "random_poses_in_box",
+]

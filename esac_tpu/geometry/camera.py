@@ -22,6 +22,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from esac_tpu.geometry.rotations import rot_error_deg
+from esac_tpu.utils.num import safe_norm
 from esac_tpu.utils.precision import heinsum, hmm
 
 # Minimum camera-frame depth (meters) used to keep the perspective division
@@ -62,7 +63,9 @@ def reprojection_errors(
     """
     Y = transform_points(R, t, X)
     xp = project(Y, f, c)
-    err = jnp.linalg.norm(xp - x2d, axis=-1)
+    # safe_norm: this is differentiated in soft-inlier scoring, and a perfect
+    # correspondence (zero error) would make a plain norm's gradient NaN.
+    err = safe_norm(xp - x2d)
     behind = Y[..., 2] < MIN_DEPTH
     # Keep gradients alive through the clamped projection but make sure
     # behind-camera points can never look like inliers.
@@ -83,5 +86,7 @@ def pose_errors(
     rot_err = rot_error_deg(R, R_gt)
     cam_center = -heinsum("...ij,...i->...j", R, t)
     cam_center_gt = -heinsum("...ij,...i->...j", R_gt, t_gt)
-    trans_err = jnp.linalg.norm(cam_center - cam_center_gt, axis=-1)
+    # safe_norm: sits under jax.grad in the pose loss; a plain norm's
+    # gradient is NaN at exactly zero error.
+    trans_err = safe_norm(cam_center - cam_center_gt)
     return rot_err, trans_err

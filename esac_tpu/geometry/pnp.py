@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from esac_tpu.geometry.camera import MIN_DEPTH, reprojection_errors
 from esac_tpu.geometry.quartic import solve_quartic
 from esac_tpu.geometry.rotations import rodrigues, so3_log
+from esac_tpu.utils.num import safe_sqrt
 from esac_tpu.utils.precision import hmm
 
 # Pair indices of the 6 unordered pairs of 4 points.
@@ -97,15 +98,23 @@ def _p3p_depths(b3: jnp.ndarray, X3: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndar
 
     Dv = d1 * v + d0
     Ev = (e2 * v + e1) * v + e0
-    u = -Ev / jnp.where(jnp.abs(Dv) < 1e-9, 1e-9, Dv)
+    # Sign-preserving clamp (sign(0) -> +1): replacing a tiny negative Dv by
+    # +1e-9 would silently flip u's sign; instead clamp toward the same sign
+    # and penalize the branch like the other degeneracies.
+    Dv_sign = jnp.where(Dv < 0, -1.0, 1.0)
+    Dv_safe = jnp.where(jnp.abs(Dv) < 1e-9, Dv_sign * 1e-9, Dv)
+    u = -Ev / Dv_safe
     denom = 1.0 + v * v - 2.0 * v * cb
-    s1 = jnp.sqrt(bsq / jnp.maximum(denom, 1e-9))
+    # safe_sqrt: bsq = 0 for a degenerate sample, and sqrt's VJP at 0 is inf —
+    # one such sample would NaN the whole vmapped batch gradient.
+    s1 = safe_sqrt(bsq / jnp.maximum(denom, 1e-9))
     depths = jnp.stack([s1, u * s1, v * s1], axis=-1)  # (4 roots, 3 points)
 
     penalty = (
         imag_pen
         + 1e3 * jnp.sum(jnp.maximum(MIN_DEPTH - depths, 0.0), axis=-1)
         + 1e3 * (denom < 1e-9).astype(v.dtype)
+        + 1e3 * (jnp.abs(Dv) < 1e-9).astype(v.dtype)
     )
     return depths, penalty
 
@@ -115,6 +124,11 @@ def _kabsch(X: jnp.ndarray, Y: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     Xm = X.mean(axis=0)
     Ym = Y.mean(axis=0)
     H = hmm((X - Xm).T, Y - Ym)
+    # Distinct-diagonal jitter: the SVD VJP has 1/(s_i^2 - s_j^2) factors, so
+    # repeated singular values (e.g. H = 0 for a degenerate sample) give NaN
+    # gradients.  1e-6 is ~1e-6 of a typical H entry (meter-scale spreads);
+    # the GN polish removes any forward bias.
+    H = H + jnp.diag(jnp.array([1e-6, 2e-6, 3e-6], dtype=H.dtype))
     U, _, Vt = jnp.linalg.svd(H)
     # Proper rotation: flip the last singular direction if det < 0.
     det = jnp.linalg.det(hmm(Vt.T, U.T))

@@ -16,6 +16,16 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 _EPS = 1e-12
+# Complex-sqrt epsilon: sqrt's VJP is g/(2 sqrt(z)), infinite at z = 0 (double
+# roots, all-zero degenerate polynomials).  Adding a tiny real eps keeps the
+# backward finite (large-but-finite is safe; inf turns into NaN under the
+# masked selects downstream).  1e-18 shifts roots by ~1e-9 — far below the
+# float32 accuracy of the solver itself.
+_SQRT_EPS = 1e-18
+
+
+def _safe_csqrt(z: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sqrt(z + _SQRT_EPS)
 
 
 def _cbrt(z: jnp.ndarray) -> jnp.ndarray:
@@ -33,7 +43,7 @@ def solve_cubic(B: jnp.ndarray, C: jnp.ndarray, D: jnp.ndarray) -> jnp.ndarray:
     D = D.astype(jnp.complex64)
     P = C - B * B / 3.0
     Q = 2.0 * B**3 / 27.0 - B * C / 3.0 + D
-    S = jnp.sqrt((Q / 2.0) ** 2 + (P / 3.0) ** 3)
+    S = _safe_csqrt((Q / 2.0) ** 2 + (P / 3.0) ** 3)
     z1 = -Q / 2.0 + S
     z2 = -Q / 2.0 - S
     # Use the larger branch for the cube root to avoid cancellation.
@@ -58,14 +68,14 @@ def _ferrari(a3: jnp.ndarray, a2: jnp.ndarray, a1: jnp.ndarray, a0: jnp.ndarray)
     # Largest |m| keeps s = sqrt(2m) well away from zero (m=0 happens iff q=0,
     # where the biquadratic factorization is exact anyway).
     m = m_roots[jnp.argmax(jnp.abs(m_roots))]
-    s = jnp.sqrt(2.0 * m)
+    s = _safe_csqrt(2.0 * m)
     s_safe = jnp.where(jnp.abs(s) < _EPS, 1.0 + 0j, s)
     qs = jnp.where(jnp.abs(s) < _EPS, 0.0 + 0j, q / (2.0 * s_safe))
 
     t1 = p / 2.0 + m - qs
     t2 = p / 2.0 + m + qs
-    d1 = jnp.sqrt(s * s - 4.0 * t1)
-    d2 = jnp.sqrt(s * s - 4.0 * t2)
+    d1 = _safe_csqrt(s * s - 4.0 * t1)
+    d2 = _safe_csqrt(s * s - 4.0 * t2)
     y = jnp.stack(
         [
             (-s + d1) / 2.0,
@@ -90,10 +100,12 @@ def solve_quartic(coeffs: jnp.ndarray) -> jnp.ndarray:
     penalties reject.  A relative floor keeps the untaken branch finite so no
     NaN can leak through ``where``.
     """
-    # 1e-25 (not smaller): this epsilon can get multiplied into a caller's
-    # denominator if XLA fuses nested divisions; it must stay comfortably
-    # above float32 underflow so the fused denominator never hits zero.
-    scale = jnp.max(jnp.abs(coeffs)) + 1e-25
+    # Scale selection, not scale + eps: the divide VJP computes -g*x/y^2, and
+    # a tiny additive epsilon squares into float32 underflow (0/0 = NaN in
+    # the backward pass at an all-zero polynomial).  A `where` keeps the
+    # denominator O(1) in the degenerate case and exact otherwise.
+    mx = jnp.max(jnp.abs(coeffs))
+    scale = jnp.where(mx > 1e-15, mx, 1.0)
     c = (coeffs / scale).astype(jnp.float32)
     q4, q0 = c[0], c[4]
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from esac_tpu.utils.num import safe_norm
 from esac_tpu.utils.precision import hmm
 
 # Below this angle (radians) the sin(x)/x style factors switch to their
@@ -59,11 +60,15 @@ def so3_log(R: jnp.ndarray) -> jnp.ndarray:
     """Rotation matrix -> axis-angle vector. (..., 3, 3) -> (..., 3).
 
     Uses the skew-part formula away from 0 and pi; near pi falls back to the
-    diagonal formula for the axis.  Branchless via ``where``.
+    outer-product formula for the axis.  Branchless via ``where``, and — the
+    part that matters in this codebase — NaN-free in the *backward* pass at
+    every input, including exact identity: a ``where`` does not stop NaNs
+    produced inside the untaken branch's VJP (0 * inf = NaN), so every norm /
+    arccos / division below is epsilon-guarded.  Called under jax.grad inside
+    vmapped minimal solves where degenerate samples do hit exact identity.
     """
     trace = R[..., 0, 0] + R[..., 1, 1] + R[..., 2, 2]
     cos_t = jnp.clip((trace - 1.0) * 0.5, -1.0, 1.0)
-    theta = jnp.arccos(cos_t)
     # Vector from the skew-symmetric part: (R - R^T)/2 = sin(t) * skew(axis).
     w = jnp.stack(
         [
@@ -73,11 +78,12 @@ def so3_log(R: jnp.ndarray) -> jnp.ndarray:
         ],
         axis=-1,
     )
-    sin_t = jnp.sin(theta)
-    small = sin_t < _SMALL_ANGLE
+    two_sin = safe_norm(w)  # = 2 sin(t), grad-safe at 0
+    # atan2 instead of arccos: finite derivative at cos_t = +-1.
+    theta = jnp.arctan2(two_sin, trace - 1.0)
+    small = two_sin < 2.0 * _SMALL_ANGLE
     near_pi = cos_t < -0.999
-    safe_sin = jnp.where(small, 1.0, sin_t)
-    axis_generic = w / (2.0 * safe_sin[..., None])
+    axis_generic = w / two_sin[..., None]
     # Near pi: R + R^T = 2 cos(t) I + 2 (1 - cos(t)) a a^T, so the outer
     # product a a^T is recoverable with a well-conditioned denominator
     # (1 - cos(t) ~ 2).  Take its largest column as +-a, then orient the sign
@@ -91,7 +97,7 @@ def so3_log(R: jnp.ndarray) -> jnp.ndarray:
     diag = jnp.stack([M[..., 0, 0], M[..., 1, 1], M[..., 2, 2]], axis=-1)
     k = jnp.argmax(diag, axis=-1)
     col = jnp.take_along_axis(M, k[..., None, None], axis=-1)[..., 0]
-    axis_pi = col / (jnp.linalg.norm(col, axis=-1, keepdims=True) + 1e-12)
+    axis_pi = col / safe_norm(col)[..., None]
     orient = jnp.sum(w * axis_pi, axis=-1, keepdims=True)
     axis_pi = axis_pi * jnp.where(orient < 0, -1.0, 1.0)
     axis = jnp.where(near_pi[..., None], axis_pi, axis_generic)
@@ -102,10 +108,25 @@ def so3_log(R: jnp.ndarray) -> jnp.ndarray:
 
 
 def rotation_angle_deg(R: jnp.ndarray) -> jnp.ndarray:
-    """Rotation angle of R in degrees. (..., 3, 3) -> (...)."""
+    """Rotation angle of R in degrees. (..., 3, 3) -> (...).
+
+    atan2 formulation, not arccos: the angle sits under ``jax.grad`` in the
+    training pose loss, and d/dx arccos(x) is infinite at x = +-1 — exactly
+    where a perfectly-refined hypothesis lands.  With
+    ||skew part|| = 2 sin(t) and trace - 1 = 2 cos(t), atan2 has finite
+    gradients everywhere (the eps keeps the sqrt differentiable at t = 0).
+    """
     trace = R[..., 0, 0] + R[..., 1, 1] + R[..., 2, 2]
-    cos_t = jnp.clip((trace - 1.0) * 0.5, -1.0, 1.0)
-    return jnp.degrees(jnp.arccos(cos_t))
+    w = jnp.stack(
+        [
+            R[..., 2, 1] - R[..., 1, 2],
+            R[..., 0, 2] - R[..., 2, 0],
+            R[..., 1, 0] - R[..., 0, 1],
+        ],
+        axis=-1,
+    )
+    two_sin = safe_norm(w)
+    return jnp.degrees(jnp.arctan2(two_sin, trace - 1.0))
 
 
 def rot_error_deg(R1: jnp.ndarray, R2: jnp.ndarray) -> jnp.ndarray:

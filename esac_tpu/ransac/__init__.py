@@ -1,0 +1,31 @@
+"""The vmap'd differentiable-RANSAC hypothesis kernel.
+
+This package replaces the reference's C++/OpenMP/OpenCV torch extension
+(SURVEY.md §2 #3-5, §3.5): hypothesis sampling, minimal PnP solves,
+soft-inlier scoring, softmax/argmax selection and pose refinement all run as
+one XLA program, vmapped over the hypothesis axis on TPU instead of looping
+over OpenMP threads on the host.
+"""
+
+from esac_tpu.ransac.config import RansacConfig
+from esac_tpu.ransac.sampling import sample_correspondence_sets
+from esac_tpu.ransac.scoring import reprojection_error_map, soft_inlier_score
+from esac_tpu.ransac.refine import refine_soft_inliers
+from esac_tpu.ransac.kernel import (
+    dsac_infer,
+    dsac_train_loss,
+    generate_hypotheses,
+    pose_loss,
+)
+
+__all__ = [
+    "RansacConfig",
+    "sample_correspondence_sets",
+    "reprojection_error_map",
+    "soft_inlier_score",
+    "refine_soft_inliers",
+    "generate_hypotheses",
+    "dsac_infer",
+    "dsac_train_loss",
+    "pose_loss",
+]

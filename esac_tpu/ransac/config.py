@@ -1,0 +1,36 @@
+"""Hyperparameters of the hypothesis loop.
+
+Defaults mirror the reference's standard configuration (SURVEY.md §0: n=256
+hypotheses, tau ~ 10 px soft-inlier threshold, sigmoid sharpness beta,
+selection temperature alpha; exact constants are [P-med] since the reference
+mount was empty).  Everything is a static field so the config can be a
+``static_argnum`` under jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class RansacConfig:
+    # Number of pose hypotheses drawn per frame.
+    n_hyps: int = 256
+    # Soft-inlier reprojection threshold, pixels.
+    tau: float = 10.0
+    # Sigmoid sharpness of the soft-inlier count: sigmoid(beta * (tau - r)).
+    beta: float = 0.5
+    # Softmax temperature over scores for hypothesis selection in training.
+    alpha: float = 0.1
+    # IRLS (re-weighted Gauss-Newton) rounds when refining the winning pose.
+    refine_iters: int = 8
+    # Light per-hypothesis refinement rounds inside the training expectation.
+    train_refine_iters: int = 2
+    # Gauss-Newton polish iterations inside the minimal solver.
+    polish_iters: int = 3
+    # Pose-loss translation weight: loss = max(rot_deg, trans_m * trans_scale).
+    # 100.0 puts 1 cm == 1 degree, aligning with the 5cm/5deg metric.
+    trans_scale: float = 100.0
+    # Clamp on the per-hypothesis pose loss (degrees-equivalent units) so a
+    # few wild hypotheses cannot dominate the training expectation.
+    loss_clamp: float = 100.0

@@ -1,0 +1,160 @@
+"""The hypothesis kernel: sample -> solve -> score -> select -> refine.
+
+One frame's whole differentiable-RANSAC loop as a single jitted function,
+vmapped over the hypothesis axis.  This is the TPU replacement for the
+reference's ``esac.forward``/``esac.backward`` C++ extension entry points
+(SURVEY.md §2 #3-4, §3.5): where the reference crosses host<->GPU<->C++ per
+frame, everything here stays on-chip, and ``jax.grad`` of
+``dsac_train_loss`` provides the entire backward pass (analytic through
+scoring and selection, autodiff-through-IRLS for refinement, no central
+finite differences).
+
+Batching conventions: all functions take ONE frame (coords (N, 3)); batch
+with ``jax.vmap`` and shard the batch axis with ``pjit`` (streaming config #5
+in BASELINE.md).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from esac_tpu.geometry.camera import pose_errors
+from esac_tpu.geometry.pnp import solve_pnp_minimal
+from esac_tpu.geometry.rotations import rodrigues
+from esac_tpu.ransac.config import RansacConfig
+from esac_tpu.ransac.refine import refine_soft_inliers
+from esac_tpu.ransac.sampling import sample_correspondence_sets
+from esac_tpu.ransac.scoring import reprojection_error_map, soft_inlier_score
+
+
+def generate_hypotheses(
+    key: jax.Array,
+    coords: jnp.ndarray,
+    pixels: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    cfg: RansacConfig,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Sample minimal sets and solve PnP for every hypothesis.
+
+    coords: (N, 3) scene coordinates, pixels: (N, 2).
+    Returns rvecs, tvecs of shape (n_hyps, 3).
+    """
+    idx = sample_correspondence_sets(key, cfg.n_hyps, coords.shape[0])
+    X4 = coords[idx]  # (n_hyps, 4, 3)
+    x4 = pixels[idx]  # (n_hyps, 4, 2)
+    solve = jax.vmap(
+        lambda Xi, xi: solve_pnp_minimal(Xi, xi, f, c, polish_iters=cfg.polish_iters)
+    )
+    return solve(X4, x4)
+
+
+def pose_loss(
+    rvec: jnp.ndarray,
+    tvec: jnp.ndarray,
+    R_gt: jnp.ndarray,
+    t_gt: jnp.ndarray,
+    cfg: RansacConfig,
+) -> jnp.ndarray:
+    """Combined pose loss: max(rot err deg, trans err * trans_scale), clamped.
+
+    The max-combination aligns the loss surface with the 5cm/5deg acceptance
+    metric (1 cm == 1 deg at trans_scale=100); the clamp bounds the influence
+    of wild hypotheses in the training expectation.
+    """
+    r_err, t_err = pose_errors(rodrigues(rvec), tvec, R_gt, t_gt)
+    return jnp.minimum(jnp.maximum(r_err, t_err * cfg.trans_scale), cfg.loss_clamp)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def dsac_infer(
+    key: jax.Array,
+    coords: jnp.ndarray,
+    pixels: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    cfg: RansacConfig = RansacConfig(),
+) -> dict:
+    """Inference: argmax-select the best-scoring hypothesis, refine it fully.
+
+    Returns dict with 'rvec', 'tvec' (the refined winner), 'scores'
+    (n_hyps,), 'best' (index), 'inlier_frac' of the winner.
+    """
+    rvecs, tvecs = generate_hypotheses(key, coords, pixels, f, c, cfg)
+    errors = reprojection_error_map(rvecs, tvecs, coords, pixels, f, c)
+    scores = soft_inlier_score(errors, cfg.tau, cfg.beta)
+    best = jnp.argmax(scores)
+    rvec, tvec = refine_soft_inliers(
+        rvecs[best],
+        tvecs[best],
+        coords,
+        pixels,
+        f,
+        c,
+        cfg.tau,
+        cfg.beta,
+        iters=cfg.refine_iters,
+    )
+    n_cells = coords.shape[0]
+    return {
+        "rvec": rvec,
+        "tvec": tvec,
+        "scores": scores,
+        "best": best,
+        "inlier_frac": scores[best] / n_cells,
+    }
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def dsac_train_loss(
+    key: jax.Array,
+    coords: jnp.ndarray,
+    pixels: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    R_gt: jnp.ndarray,
+    t_gt: jnp.ndarray,
+    cfg: RansacConfig = RansacConfig(),
+) -> tuple[jnp.ndarray, dict]:
+    """Training: expected pose loss under softmax hypothesis selection.
+
+    E_{j ~ softmax(alpha * score)} [ pose_loss(refine_light(h_j)) ].
+
+    Unlike the reference — which refines only the selected hypothesis because
+    CPU refinement is expensive — every hypothesis gets a light IRLS
+    refinement inside the expectation (cheap when vmapped on TPU), which
+    lowers estimator variance.  Gradients flow to ``coords`` through (a) the
+    minimal solves, (b) the soft-inlier scores inside the selection softmax,
+    and (c) the refinement residuals.  Differentiate with ``jax.grad`` wrt
+    ``coords`` (or wrt network params through them).
+
+    Returns (loss, aux) where aux holds 'expected_loss', 'best_loss',
+    'selection_probs', 'scores'.
+    """
+    rvecs, tvecs = generate_hypotheses(key, coords, pixels, f, c, cfg)
+    errors = reprojection_error_map(rvecs, tvecs, coords, pixels, f, c)
+    scores = soft_inlier_score(errors, cfg.tau, cfg.beta)
+    probs = jax.nn.softmax(cfg.alpha * scores)
+
+    refine = jax.vmap(
+        lambda rv, tv: refine_soft_inliers(
+            rv, tv, coords, pixels, f, c, cfg.tau, cfg.beta,
+            iters=cfg.train_refine_iters,
+        )
+    )
+    rvecs_r, tvecs_r = refine(rvecs, tvecs)
+    losses = jax.vmap(lambda rv, tv: pose_loss(rv, tv, R_gt, t_gt, cfg))(
+        rvecs_r, tvecs_r
+    )
+    expected = jnp.sum(probs * losses)
+    aux = {
+        "expected_loss": expected,
+        "best_loss": losses[jnp.argmax(scores)],
+        "selection_probs": probs,
+        "scores": scores,
+        "entropy": -jnp.sum(probs * jnp.log(probs + 1e-12)),
+    }
+    return expected, aux

@@ -1,0 +1,60 @@
+"""Soft-inlier IRLS pose refinement.
+
+The reference refines the winning pose by re-solving PnP on the hard inlier
+set until convergence, capped at ~100 iterations, and differentiates the
+result by central finite differences (SURVEY.md §3.5).  The TPU-native
+equivalent is IRLS with *soft* inlier weights: recompute per-cell sigmoid
+weights, take one weighted Gauss-Newton step, repeat a fixed number of
+rounds.  Fixed iteration counts keep it jit/vmap-safe; softness keeps it
+differentiable end-to-end, so ``jax.grad`` replaces the finite-difference
+machinery exactly where the reference needed it most.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from esac_tpu.geometry.camera import reprojection_errors
+from esac_tpu.geometry.pnp import refine_pose_gn
+from esac_tpu.geometry.rotations import rodrigues
+from esac_tpu.ransac.scoring import soft_inlier_weights
+
+
+@partial(jax.jit, static_argnames=("iters", "gn_steps_per_iter", "stop_weight_grad"))
+def refine_soft_inliers(
+    rvec: jnp.ndarray,
+    tvec: jnp.ndarray,
+    coords: jnp.ndarray,
+    pixels: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    tau: float,
+    beta: float,
+    iters: int = 8,
+    gn_steps_per_iter: int = 1,
+    stop_weight_grad: bool = True,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """IRLS: weights <- sigmoid(beta*(tau - r)); one weighted GN step; repeat.
+
+    ``stop_weight_grad`` blocks gradient flow through the weights (but not
+    through the residuals), the usual IRLS trick to keep the backward pass
+    cheap and stable; the loss gradient still reaches every coordinate
+    through the weighted residuals.
+    """
+
+    def body(carry, _):
+        rv, tv = carry
+        errs = reprojection_errors(rodrigues(rv), tv, coords, pixels, f, c)
+        w = soft_inlier_weights(errs, tau, beta)
+        if stop_weight_grad:
+            w = jax.lax.stop_gradient(w)
+        rv, tv = refine_pose_gn(
+            rv, tv, coords, pixels, f, c, weights=w, iters=gn_steps_per_iter
+        )
+        return (rv, tv), None
+
+    (rvec, tvec), _ = jax.lax.scan(body, (rvec, tvec), None, length=iters)
+    return rvec, tvec

@@ -1,0 +1,59 @@
+"""Correspondence-set sampling for hypothesis generation.
+
+The reference's C++ loop draws 4 random output pixels per hypothesis with a
+per-OpenMP-thread RNG (SURVEY.md §2 #5, §3.5).  Here every hypothesis gets
+its own fold of a single JAX PRNG key, and "4 distinct indices out of N" is a
+Gumbel-top-4: add i.i.d. Gumbel noise to a flat logit field and take top-k.
+That is an exact without-replacement uniform sample, fully batched — no
+rejection loop, no host RNG state.
+
+Sampling contract (the cross-backend reproducibility contract, SURVEY.md
+hard part #4): given (key, n_hyps, N), hypothesis j uses
+``jax.random.fold_in(key, j)`` and draws indices via Gumbel-top-4 over N
+cells.  Backends cannot share bit-identical streams with the C++ path; they
+are compared statistically (same distribution) instead.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("n_hyps", "n_cells", "set_size"))
+def sample_correspondence_sets(
+    key: jax.Array,
+    n_hyps: int,
+    n_cells: int,
+    set_size: int = 4,
+) -> jnp.ndarray:
+    """Draw ``n_hyps`` sets of ``set_size`` distinct indices in [0, n_cells).
+
+    Returns (n_hyps, set_size) int32.
+    """
+    keys = jax.random.split(key, n_hyps)
+
+    def one(k):
+        g = jax.random.gumbel(k, (n_cells,))
+        _, idx = jax.lax.top_k(g, set_size)
+        return idx
+
+    return jax.vmap(one)(keys)
+
+
+def sample_expert_indices(
+    key: jax.Array,
+    gating_probs: jnp.ndarray,
+    n_hyps: int,
+) -> jnp.ndarray:
+    """Draw one expert index per hypothesis from the gating distribution.
+
+    gating_probs: (M,) softmax output of the gating network.  Returns
+    (n_hyps,) int32.  This is the discrete draw that gets a score-function
+    (REINFORCE) gradient during end-to-end training (SURVEY.md §0 step 1).
+    """
+    return jax.random.categorical(
+        key, jnp.log(gating_probs + 1e-12), shape=(n_hyps,)
+    )

@@ -1,3 +1,4 @@
+from esac_tpu.utils.num import safe_norm, safe_sqrt
 from esac_tpu.utils.precision import hmm, heinsum
 
-__all__ = ["hmm", "heinsum"]
+__all__ = ["hmm", "heinsum", "safe_norm", "safe_sqrt"]

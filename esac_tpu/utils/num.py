@@ -1,0 +1,29 @@
+"""Gradient-safe numerical primitives.
+
+Everything in the geometry core sits under ``jax.grad`` inside a ``vmap``
+over thousands of random minimal samples; a single degenerate sample with an
+exact zero (norm at 0, sqrt at 0, repeated singular values) produces an
+inf/NaN *backward* value that poisons the entire batch gradient, even when
+the forward value is masked by ``where`` (0 * inf = NaN).  These helpers put
+the epsilon *inside* the sqrt so both forward and backward stay finite.
+
+Epsilon policy: 1e-12 under a sqrt gives a 1e-6 floor — far below any
+physically meaningful pixel/meter/radian quantity here, far above float32
+underflow.  Use ``eps`` overrides only with a comment justifying the scale.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+DEFAULT_EPS = 1e-12
+
+
+def safe_norm(x: jnp.ndarray, axis: int = -1, eps: float = DEFAULT_EPS) -> jnp.ndarray:
+    """L2 norm with finite gradient at ``x = 0`` (eps inside the sqrt)."""
+    return jnp.sqrt(jnp.sum(x * x, axis=axis) + eps)
+
+
+def safe_sqrt(x: jnp.ndarray, eps: float = DEFAULT_EPS) -> jnp.ndarray:
+    """sqrt with finite gradient at 0 (works for real and complex inputs)."""
+    return jnp.sqrt(x + eps)

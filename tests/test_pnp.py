@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 from esac_tpu.geometry import (
+    so3_log,
     pose_errors,
     project,
     refine_pose_gn,
@@ -117,3 +118,24 @@ def test_refine_gradient_matches_finite_differences():
         Xm = X.at[idx].add(-eps)
         fd = (loss(Xp) - loss(Xm)) / (2 * eps)
         np.testing.assert_allclose(g[idx], fd, rtol=0.05, atol=1e-4)
+
+
+def test_degenerate_sample_gradient_is_finite():
+    """One degenerate minimal sample must not NaN a vmapped batch gradient."""
+    X_deg = jnp.tile(jnp.array([[0.0, 0.0, 4.0]]), (4, 1))
+    x_deg = jnp.tile(C[None], (4, 1))
+    _, _, X_ok, x_ok = make_problem(jax.random.key(20))
+    Xb = jnp.stack([X_deg, X_ok])
+    xb = jnp.stack([x_deg, x_ok])
+
+    def loss(Xin):
+        rv, tv = jax.vmap(lambda a, b: solve_pnp_minimal(a, b, F, C))(Xin, xb)
+        return jnp.sum(rv) + jnp.sum(tv)
+
+    g = jax.grad(loss)(Xb)
+    assert jnp.all(jnp.isfinite(g)), g
+
+
+def test_so3_log_gradient_at_identity():
+    g = jax.grad(lambda R: jnp.sum(so3_log(R)))(jnp.eye(3))
+    assert jnp.all(jnp.isfinite(g))
