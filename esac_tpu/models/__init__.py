@@ -1,0 +1,22 @@
+"""Flax models: per-scene expert FCNs + the gating network.
+
+The reference implements both as PyTorch ``nn.Module``s (SURVEY.md §2 #1-2:
+a VGG-style fully-convolutional scene-coordinate regressor with stride-8
+output, ~10^7 params, and a CNN classifier over M experts).  Here they are
+Flax modules designed TPU-first: bfloat16 compute / float32 params, channel
+counts sized for the MXU's 128-lane tiling, and a static config so the same
+module scales from test-size to reference-size.
+"""
+
+from esac_tpu.models.expert import ExpertNet, coordinate_loss, reprojection_loss
+from esac_tpu.models.gating import GatingNet
+from esac_tpu.models.convert import torch_conv_to_flax, torch_state_dict_to_flax
+
+__all__ = [
+    "ExpertNet",
+    "GatingNet",
+    "coordinate_loss",
+    "reprojection_loss",
+    "torch_conv_to_flax",
+    "torch_state_dict_to_flax",
+]

@@ -1,0 +1,98 @@
+"""Torch <-> Flax checkpoint conversion.
+
+The reference stores ``torch.save(net.state_dict())`` checkpoints
+(SURVEY.md §5 "Checkpoint / resume"); interchanging them with the jax
+backend requires the layout conversion below.  Torch Conv2d weights are
+(out, in, kH, kW) = OIHW; Flax ``nn.Conv`` kernels are (kH, kW, in, out) =
+HWIO.  Torch Linear weights are (out, in); Flax Dense kernels are (in, out).
+
+``torch_state_dict_to_flax`` maps a state dict whose layer ORDER matches the
+Flax module's parameter order (the reference nets are plain sequential
+stacks, so ordinal matching is exact); names need not match.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def torch_conv_to_flax(weight: np.ndarray, bias: np.ndarray | None = None) -> dict:
+    """OIHW torch conv weight (+bias) -> flax {'kernel': HWIO, 'bias': ...}."""
+    out = {"kernel": jnp.asarray(np.transpose(weight, (2, 3, 1, 0)))}
+    if bias is not None:
+        out["bias"] = jnp.asarray(bias)
+    return out
+
+
+def torch_linear_to_flax(weight: np.ndarray, bias: np.ndarray | None = None) -> dict:
+    """(out, in) torch linear weight (+bias) -> flax {'kernel': (in, out), ...}."""
+    out = {"kernel": jnp.asarray(np.transpose(weight, (1, 0)))}
+    if bias is not None:
+        out["bias"] = jnp.asarray(bias)
+    return out
+
+
+def torch_state_dict_to_flax(
+    state_dict: Mapping[str, Any],
+    flax_params: Mapping[str, Any],
+) -> dict:
+    """Fill a Flax param pytree from a torch state dict by layer order.
+
+    state_dict: torch name -> tensor/ndarray (CPU).  flax_params: the target
+    module's initialized ``params`` tree (gives names and expected shapes).
+    Returns a new params tree.  Raises ValueError on a shape mismatch, which
+    catches architecture drift early.
+    """
+    # Group torch entries into (weight, bias) pairs in order of appearance.
+    pairs: list[tuple[np.ndarray, np.ndarray | None]] = []
+    pending_w: np.ndarray | None = None
+    pending_name = ""
+    for name, value in state_dict.items():
+        arr = np.asarray(value.detach().cpu() if hasattr(value, "detach") else value)
+        if name.endswith("weight"):
+            if pending_w is not None:
+                pairs.append((pending_w, None))
+            pending_w, pending_name = arr, name
+        elif name.endswith("bias"):
+            if pending_w is None or name[: -len("bias")] != pending_name[: -len("weight")]:
+                raise ValueError(f"bias {name} does not follow its weight")
+            pairs.append((pending_w, arr))
+            pending_w = None
+        else:
+            raise ValueError(f"unsupported torch entry: {name}")
+    if pending_w is not None:
+        pairs.append((pending_w, None))
+
+    # Walk the flax tree in definition order (flax dict insertion order is
+    # module declaration order for nn.compact modules).
+    leaves: list[tuple[str, dict]] = []
+
+    def walk(tree, prefix=""):
+        if "kernel" in tree:
+            leaves.append((prefix, tree))
+            return
+        for k in tree:
+            walk(tree[k], f"{prefix}/{k}")
+
+    import copy
+
+    new_params = copy.deepcopy({k: v for k, v in flax_params.items()})
+    walk(new_params)
+    if len(leaves) != len(pairs):
+        raise ValueError(
+            f"layer count mismatch: torch has {len(pairs)}, flax has {len(leaves)}"
+        )
+    for (name, leaf), (w, b) in zip(leaves, pairs):
+        conv = torch_conv_to_flax(w, b) if w.ndim == 4 else torch_linear_to_flax(w, b)
+        if conv["kernel"].shape != leaf["kernel"].shape:
+            raise ValueError(
+                f"shape mismatch at {name}: torch {conv['kernel'].shape} "
+                f"vs flax {leaf['kernel'].shape}"
+            )
+        leaf["kernel"] = conv["kernel"]
+        if b is not None:
+            leaf["bias"] = conv["bias"]
+    return new_params

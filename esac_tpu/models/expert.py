@@ -1,0 +1,105 @@
+"""Expert scene-coordinate regression network.
+
+Reference counterpart: the VGG-style FCN in the reference's ``network.py``
+(SURVEY.md §2 #1; expected path, mount was empty): RGB (H, W, 3) -> scene
+coordinates (H/8, W/8, 3), one network per scene/cluster.
+
+TPU-first choices:
+- bfloat16 activations/compute, float32 parameters (MXU-native mixed
+  precision); the coordinate head upcasts to float32 before the residual
+  add so centimeter precision survives.
+- channel widths are multiples of 128 at the deep end (MXU lane width).
+- output = predicted offset + scene center: the net regresses deviations
+  around a per-scene mean, as the reference does with its scene-translation
+  initialization.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ExpertNet(nn.Module):
+    """Fully-convolutional scene-coordinate regressor, stride-8 output.
+
+    Attributes:
+      scene_center: (3,) added to the predicted offsets (meters).
+      stem_channels: channels of the three stride-2 stages.
+      head_channels: channels of the stride-1 trunk after downsampling.
+      head_depth: number of 3x3 stride-1 conv blocks in the trunk.
+      compute_dtype: activation dtype (bfloat16 on TPU).
+    """
+
+    scene_center: tuple[float, float, float] = (0.0, 0.0, 0.0)
+    stem_channels: Sequence[int] = (64, 128, 256)
+    head_channels: int = 512
+    head_depth: int = 4
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        """(..., H, W, 3) RGB in [0,1] -> (..., H/8, W/8, 3) scene coords."""
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(self.stem_channels[0] // 2, (3, 3), dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        for ch in self.stem_channels:
+            x = nn.Conv(ch, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)),
+                    dtype=self.compute_dtype)(x)
+            x = nn.relu(x)
+            x = nn.Conv(ch, (3, 3), dtype=self.compute_dtype)(x)
+            x = nn.relu(x)
+        for _ in range(self.head_depth):
+            # Residual 3x3 blocks at stride 1 keep the receptive field growing
+            # without more downsampling (output must stay H/8).
+            h = nn.Conv(self.head_channels, (3, 3), dtype=self.compute_dtype)(x)
+            h = nn.relu(h)
+            h = nn.Conv(self.head_channels, (1, 1), dtype=self.compute_dtype)(h)
+            if x.shape[-1] != self.head_channels:
+                x = nn.Conv(self.head_channels, (1, 1), dtype=self.compute_dtype)(x)
+            x = nn.relu(x + h)
+        # Coordinate head in float32: bf16 has ~3 decimal digits, not enough
+        # for centimeter targets at meter scale.
+        x = nn.Conv(3, (1, 1), dtype=jnp.float32, param_dtype=jnp.float32)(
+            x.astype(jnp.float32)
+        )
+        return x + jnp.asarray(self.scene_center, dtype=jnp.float32)
+
+
+def coordinate_loss(
+    pred: jnp.ndarray,
+    target: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Masked mean L1 distance between predicted and GT scene coordinates.
+
+    The reference's stage-1 "coordinate" loss (SURVEY.md §3.1).  pred/target:
+    (..., 3); mask: (...) with 1 = valid GT (invalid depth pixels are masked).
+    """
+    dist = jnp.sum(jnp.abs(pred - target), axis=-1)
+    if mask is None:
+        return jnp.mean(dist)
+    return jnp.sum(dist * mask) / (jnp.sum(mask) + 1e-9)
+
+
+def reprojection_loss(
+    pred: jnp.ndarray,
+    pixels: jnp.ndarray,
+    R_gt: jnp.ndarray,
+    t_gt: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    clamp_px: float = 100.0,
+) -> jnp.ndarray:
+    """Mean clamped reprojection error under the GT pose.
+
+    The reference's depth-free init objective for outdoor scenes
+    (SURVEY.md §0 stage 1).  pred: (N, 3) coords, pixels: (N, 2).
+    """
+    from esac_tpu.geometry.camera import reprojection_errors
+    from esac_tpu.geometry.rotations import rodrigues  # noqa: F401  (kept local to avoid cycle)
+
+    errs = reprojection_errors(R_gt, t_gt, pred, pixels, f, c)
+    return jnp.mean(jnp.minimum(errs, clamp_px))

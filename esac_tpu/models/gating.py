@@ -1,0 +1,47 @@
+"""Gating network: image -> distribution over experts.
+
+Reference counterpart: the CNN classifier in the reference (SURVEY.md §2 #2)
+trained with cross-entropy against the GT scene/cluster label (stage 2) and
+with a score-function estimator end-to-end (stage 3).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class GatingNet(nn.Module):
+    """CNN classifier over M experts.
+
+    RGB (..., H, W, 3) -> logits (..., M).  Strided convs + global average
+    pool, bf16 compute / f32 params like the expert.
+    """
+
+    num_experts: int
+    channels: Sequence[int] = (32, 64, 128, 256)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.astype(self.compute_dtype)
+        for ch in self.channels:
+            x = nn.Conv(ch, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)),
+                        dtype=self.compute_dtype)(x)
+            x = nn.relu(x)
+            x = nn.Conv(ch, (3, 3), dtype=self.compute_dtype)(x)
+            x = nn.relu(x)
+        x = jnp.mean(x, axis=(-3, -2))  # global average pool
+        x = x.astype(jnp.float32)
+        x = nn.Dense(max(self.num_experts * 4, 64), dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_experts, dtype=jnp.float32)(x)
+
+
+def gating_cross_entropy(logits: jnp.ndarray, label: jnp.ndarray) -> jnp.ndarray:
+    """Stage-2 loss: cross-entropy against the GT expert label."""
+    logp = nn.log_softmax(logits, axis=-1)
+    onehot = jnp.eye(logits.shape[-1], dtype=logits.dtype)[label]
+    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))

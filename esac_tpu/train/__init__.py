@@ -1,0 +1,21 @@
+"""Three-stage training, mirroring the reference's pipeline (SURVEY.md §0):
+
+1. ``expert``  — per-expert scene-coordinate init (coordinate / reprojection
+   loss), the reference's ``train_expert.py``.
+2. ``gating``  — gating classifier init (cross-entropy), ``train_gating.py``.
+3. ``e2e``     — end-to-end expected-pose-loss training through the
+   hypothesis kernel, ``train_esac.py``.
+
+All steps are pure jitted functions over (params, opt_state, batch); entry
+scripts at the repo root provide the reference-compatible CLI.
+"""
+
+from esac_tpu.train.expert import make_expert_train_step
+from esac_tpu.train.gating import make_gating_train_step
+from esac_tpu.train.e2e import make_dsac_train_step
+
+__all__ = [
+    "make_expert_train_step",
+    "make_gating_train_step",
+    "make_dsac_train_step",
+]

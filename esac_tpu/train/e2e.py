@@ -1,0 +1,56 @@
+"""Stage-3 end-to-end training: expected pose loss through the kernel.
+
+Reference counterpart: ``train_esac.py`` (SURVEY.md §3.3).  The single-expert
+(DSAC, config #1) step trains the expert through the whole hypothesis loop:
+image -> expert -> coords -> sample/solve/score/select/refine -> expected
+pose loss; ``jax.grad`` delivers the full backward pass that the reference
+assembles from analytic C++ gradients + central finite differences.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from esac_tpu.ransac.config import RansacConfig
+from esac_tpu.ransac.kernel import dsac_train_loss
+
+
+def make_dsac_train_step(
+    net,
+    optimizer: optax.GradientTransformation,
+    cfg: RansacConfig,
+    f: float,
+    c: tuple[float, float],
+) -> Callable:
+    """Single-expert end-to-end step (driver config #1).
+
+    Returns jitted ``step(params, opt_state, key, images, pixels, R_gts,
+    t_gts)`` over a batch of frames -> (params, opt_state, loss, aux).
+    """
+    fx = jnp.float32(f)
+    cx = jnp.asarray(c, dtype=jnp.float32)
+
+    @jax.jit
+    def step(params, opt_state, key, images, pixels, R_gts, t_gts):
+        def loss_fn(p):
+            coords = net.apply(p, images)  # (B, h, w, 3)
+            B = coords.shape[0]
+            flat = coords.reshape(B, -1, 3)
+            keys = jax.random.split(key, B)
+            losses, aux = jax.vmap(
+                lambda k, co, px, Rg, tg: dsac_train_loss(
+                    k, co, px, fx, cx, Rg, tg, cfg
+                )
+            )(keys, flat, pixels, R_gts, t_gts)
+            return jnp.mean(losses), aux
+
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, aux
+
+    return step

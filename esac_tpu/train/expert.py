@@ -1,0 +1,40 @@
+"""Stage-1 expert training: scene-coordinate regression.
+
+Reference counterpart: ``train_expert.py`` hot loop (SURVEY.md §3.1):
+image -> expert forward -> masked L1 against GT coordinates (or clamped
+reprojection error when no depth GT exists) -> Adam step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from esac_tpu.models.expert import coordinate_loss
+
+
+def make_expert_train_step(
+    net,
+    optimizer: optax.GradientTransformation,
+) -> Callable:
+    """Returns jitted ``step(params, opt_state, images, targets, masks)``.
+
+    images: (B, H, W, 3); targets: (B, H/8, W/8, 3); masks: (B, H/8, W/8)
+    or None-shaped ones.  Returns (params, opt_state, loss).
+    """
+
+    @jax.jit
+    def step(params, opt_state, images, targets, masks):
+        def loss_fn(p):
+            pred = net.apply(p, images)
+            return coordinate_loss(pred, targets, masks)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step

@@ -1,0 +1,34 @@
+"""Stage-2 gating training: expert classification.
+
+Reference counterpart: ``train_gating.py`` (SURVEY.md §3.2) — cross-entropy
+against the GT scene/cluster label.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import optax
+
+from esac_tpu.models.gating import gating_cross_entropy
+
+
+def make_gating_train_step(
+    net,
+    optimizer: optax.GradientTransformation,
+) -> Callable:
+    """Returns jitted ``step(params, opt_state, images, labels)``."""
+
+    @jax.jit
+    def step(params, opt_state, images, labels):
+        def loss_fn(p):
+            logits = net.apply(p, images)
+            return gating_cross_entropy(logits, labels)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step

@@ -1,0 +1,119 @@
+"""End-to-end slice (driver config #1): train an expert on the synthetic box
+scene, localize through the full pipeline, evaluate 5cm/5deg.
+
+This is the integration test class SURVEY.md §4 calls for ("tiny synthetic
+scene that trains an expert to convergence in minutes").
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from esac_tpu.data import render_box_scene, random_poses_in_box
+from esac_tpu.geometry import pose_errors, rodrigues
+from esac_tpu.models import ExpertNet
+from esac_tpu.ransac import RansacConfig, dsac_infer
+from esac_tpu.train import make_expert_train_step, make_dsac_train_step
+
+# Tiny-but-real setting: 48x64 frames, stride 8 -> 6x8 = 48 cells.
+H, W = 48, 64
+FOCAL = 525.0 / 10.0  # keep the FOV of the 640-wide reference camera
+CENTER = (W / 2.0, H / 2.0)
+NET_KW = dict(
+    scene_center=(3.0, 2.0, 1.5),
+    stem_channels=(16, 32, 64),
+    head_channels=64,
+    head_depth=2,
+    compute_dtype=jnp.float32,  # CPU tests; bf16 is for TPU runs
+)
+
+
+def make_batch(key, n):
+    rvecs, tvecs = random_poses_in_box(key, n)
+    frames = [
+        render_box_scene(rvecs[i], tvecs[i], H, W, FOCAL, CENTER) for i in range(n)
+    ]
+    images = jnp.stack([fr["image"] for fr in frames])
+    coords = jnp.stack([fr["coords_gt"] for fr in frames]).reshape(n, H // 8, W // 8, 3)
+    pixels = frames[0]["pixels"]
+    return images, coords, pixels, rvecs, tvecs
+
+
+@pytest.fixture(scope="module")
+def trained_expert():
+    """Overfit a tiny expert on 8 frames to ~1-3 cm coordinate accuracy.
+
+    CPU CI budget rules out training for novel-view generalization (that is
+    the TPU benchmark's job); the fixture's purpose is an expert accurate
+    enough that pipeline errors, not model errors, dominate the evaluation.
+    """
+    net = ExpertNet(**NET_KW)
+    images, coords, pixels, _, _ = make_batch(jax.random.key(0), 8)
+    params = net.init(jax.random.key(1), images[:1])
+    # Cosine decay: full-batch Adam at constant LR oscillates late in
+    # training, making the final coordinate accuracy run-dependent.
+    opt = optax.adam(optax.cosine_decay_schedule(1e-3, 1500, 0.05))
+    opt_state = opt.init(params)
+    step = make_expert_train_step(net, opt)
+    masks = jnp.ones(coords.shape[:-1])
+    for _ in range(1500):
+        params, opt_state, loss = step(params, opt_state, images, coords, masks)
+    return net, params, float(loss), pixels
+
+
+def test_expert_learns_scene_coordinates(trained_expert):
+    net, params, final_loss, _ = trained_expert
+    # L1 sum over xyz below 0.2m total (~7cm/axis) proves the net inverts
+    # texture -> position on the synthetic scene.
+    assert final_loss < 0.2, f"stage-1 loss {final_loss}"
+
+
+def test_end_to_end_5cm5deg(trained_expert):
+    """Full pipeline (net -> kernel -> metrics) reaches 5cm/5deg.
+
+    Evaluates on *held-in* views: a test-size expert trained for seconds on a
+    CPU cannot generalize over 6-DoF pose space, and this test's job is the
+    numerical correctness of the pipeline, not model capacity.  Novel-view
+    accuracy at reference scale is covered by the TPU benchmark.
+    """
+    net, params, _, pixels = trained_expert
+    images, coords_gt, _, rvecs, tvecs = make_batch(jax.random.key(0), 8)
+    pred = net.apply(params, images).reshape(8, -1, 3)
+    cfg = RansacConfig(n_hyps=64, refine_iters=6)
+    n_ok = 0
+    errs = []
+    for i in range(8):
+        out = dsac_infer(
+            jax.random.key(20 + i), pred[i], pixels,
+            jnp.float32(FOCAL), jnp.asarray(CENTER), cfg,
+        )
+        r_err, t_err = pose_errors(
+            rodrigues(out["rvec"]), out["tvec"], rodrigues(rvecs[i]), tvecs[i]
+        )
+        errs.append((float(r_err), float(t_err)))
+        if r_err < 5.0 and t_err < 0.05:
+            n_ok += 1
+    assert n_ok >= 7, f"5cm/5deg on {n_ok}/8 synthetic frames; errors: {errs}"
+
+
+def test_e2e_training_step_improves_expected_loss(trained_expert):
+    net, params, _, pixels = trained_expert
+    images, _, _, rvecs, tvecs = make_batch(jax.random.key(30), 4)
+    R_gts = jax.vmap(rodrigues)(rvecs)
+    cfg = RansacConfig(n_hyps=32, train_refine_iters=1)
+    opt = optax.adam(1e-5)
+    opt_state = opt.init(params)
+    step = make_dsac_train_step(net, opt, cfg, FOCAL, CENTER)
+    pixels_b = jnp.tile(pixels[None], (4, 1, 1))
+    losses = []
+    p = params
+    for i in range(8):
+        p, opt_state, loss, aux = step(
+            p, opt_state, jax.random.key(40 + i), images, pixels_b, R_gts, tvecs
+        )
+        losses.append(float(loss))
+        assert np.isfinite(loss)
+    # Expected pose loss should not blow up and should generally improve.
+    assert losses[-1] <= losses[0] * 1.5

@@ -1,0 +1,131 @@
+"""Tests for the Flax expert / gating networks and the torch converter."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esac_tpu.models import ExpertNet, GatingNet, coordinate_loss, torch_state_dict_to_flax
+from esac_tpu.models.gating import gating_cross_entropy
+
+# Tiny configs keep CPU tests fast.
+TINY_EXPERT = dict(stem_channels=(8, 16, 32), head_channels=32, head_depth=2)
+
+
+def test_expert_output_shape_stride8():
+    net = ExpertNet(**TINY_EXPERT)
+    x = jnp.zeros((1, 64, 96, 3))
+    params = net.init(jax.random.key(0), x)
+    y = net.apply(params, x)
+    assert y.shape == (1, 8, 12, 3)
+    assert y.dtype == jnp.float32
+
+
+def test_expert_scene_center_offset():
+    net = ExpertNet(scene_center=(3.0, 2.0, 1.5), **TINY_EXPERT)
+    x = jnp.zeros((1, 32, 32, 3))
+    params = net.init(jax.random.key(0), x)
+    y = net.apply(params, x)
+    # Fresh random init with zero input: output should hover near the center.
+    assert np.abs(np.asarray(y).mean(axis=(0, 1, 2)) - np.array([3.0, 2.0, 1.5])).max() < 1.0
+
+
+def test_expert_reference_size_param_count():
+    net = ExpertNet()
+    x = jnp.zeros((1, 64, 64, 3))
+    params = net.init(jax.random.key(0), x)
+    n = sum(p.size for p in jax.tree.leaves(params))
+    # Reference expert is ~10^7 params (SURVEY.md §2 #1).
+    assert 5e6 < n < 4e7, f"{n} params"
+
+
+def test_expert_trains_one_step():
+    net = ExpertNet(**TINY_EXPERT)
+    x = jax.random.uniform(jax.random.key(1), (2, 32, 32, 3))
+    target = jax.random.uniform(jax.random.key(2), (2, 4, 4, 3)) * 4.0
+
+    params = net.init(jax.random.key(0), x)
+
+    def loss_fn(p):
+        return coordinate_loss(net.apply(p, x), target)
+
+    l0, g = jax.value_and_grad(loss_fn)(params)
+    assert jnp.isfinite(l0)
+    params2 = jax.tree.map(lambda p, gr: p - 1e-3 * gr, params, g)
+    l1 = loss_fn(params2)
+    assert l1 < l0
+
+
+def test_gating_shapes_and_loss():
+    net = GatingNet(num_experts=7, channels=(8, 16))
+    x = jnp.zeros((3, 64, 64, 3))
+    params = net.init(jax.random.key(0), x)
+    logits = net.apply(params, x)
+    assert logits.shape == (3, 7)
+    loss = gating_cross_entropy(logits, jnp.array([0, 3, 6]))
+    assert jnp.isfinite(loss)
+
+
+def test_coordinate_loss_masking():
+    pred = jnp.zeros((4, 3))
+    target = jnp.ones((4, 3))
+    mask = jnp.array([1.0, 1.0, 0.0, 0.0])
+    # Unmasked cells each contribute |1|*3; masked ignored.
+    assert coordinate_loss(pred, target, mask) == pytest.approx(3.0, abs=1e-5)
+    # All-masked: must not divide by zero.
+    assert jnp.isfinite(coordinate_loss(pred, target, jnp.zeros(4)))
+
+
+def test_torch_converter_roundtrip():
+    torch = pytest.importorskip("torch")
+
+    class TorchTwin(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.c1 = torch.nn.Conv2d(3, 8, 3, padding=1)
+            self.c2 = torch.nn.Conv2d(8, 8, 3, stride=2, padding=1)
+            self.fc = torch.nn.Linear(8, 5)
+
+        def forward(self, x):  # NCHW
+            import torch.nn.functional as tF
+
+            x = tF.relu(self.c1(x))
+            x = tF.relu(self.c2(x))
+            x = x.mean(dim=(2, 3))
+            return self.fc(x)
+
+    class FlaxTwin(__import__("flax").linen.Module):
+        @__import__("flax").linen.compact
+        def __call__(self, x):  # NHWC
+            import flax.linen as nn
+
+            x = nn.relu(nn.Conv(8, (3, 3))(x))
+            # torch padding=1 is symmetric; XLA SAME at stride 2 is not.
+            x = nn.relu(nn.Conv(8, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))(x))
+            x = x.mean(axis=(1, 2))
+            return nn.Dense(5)(x)
+
+    tnet = TorchTwin().eval()
+    fnet = FlaxTwin()
+    x = np.random.default_rng(0).uniform(size=(2, 16, 16, 3)).astype(np.float32)
+    params = fnet.init(jax.random.key(0), jnp.asarray(x))
+    converted = {"params": torch_state_dict_to_flax(tnet.state_dict(), params["params"])}
+    got = np.asarray(fnet.apply(converted, jnp.asarray(x)))
+    with torch.no_grad():
+        want = tnet(torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))).numpy()
+    np.testing.assert_allclose(got, want, atol=2e-4)
+
+
+def test_torch_converter_rejects_shape_mismatch():
+    torch = pytest.importorskip("torch")
+    import flax.linen as nn
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(x)
+
+    params = Tiny().init(jax.random.key(0), jnp.zeros((1, 8)))
+    bad = {"fc.weight": torch.zeros(4, 99), "fc.bias": torch.zeros(4)}
+    with pytest.raises(ValueError, match="shape mismatch"):
+        torch_state_dict_to_flax(bad, params["params"])
