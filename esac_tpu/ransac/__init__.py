@@ -17,6 +17,7 @@ from esac_tpu.ransac.kernel import (
     generate_hypotheses,
     pose_loss,
 )
+from esac_tpu.ransac.esac import esac_infer, esac_train_loss
 
 __all__ = [
     "RansacConfig",
@@ -27,5 +28,7 @@ __all__ = [
     "generate_hypotheses",
     "dsac_infer",
     "dsac_train_loss",
+    "esac_infer",
+    "esac_train_loss",
     "pose_loss",
 ]

@@ -1,0 +1,229 @@
+"""Multi-expert ESAC: gating-routed expert sample consensus.
+
+Reference counterpart: the mixture-of-experts hypothesis loop of
+``esac.forward``/``backward`` (SURVEY.md §0, §3.3): draw an expert per
+hypothesis from the gating distribution, run only drawn experts (host-side
+sparsity), score each hypothesis on its expert's own coordinate map, select
+globally, and push a REINFORCE gradient into the gating net.
+
+TPU-first redesign: for M <= ~a dozen experts per device, running *all*
+experts densely beats host-side sparsity (no data-dependent shapes, full MXU
+utilization), so:
+
+- ``esac_infer`` / ``esac_train_loss(mode='dense')`` allocate ``cfg.n_hyps``
+  hypotheses to EVERY expert (the reference's "256 hyp/expert", BASELINE.md
+  config #2), score within-expert, and combine across experts.  In dense
+  training the gating gradient is *exact* — total loss = sum_m g_m L_m is
+  directly differentiable — eliminating the REINFORCE variance entirely
+  (SURVEY.md hard part #5).
+- ``esac_train_loss(mode='sampled')`` reproduces the reference's estimator:
+  categorical expert draw per hypothesis + score-function (REINFORCE)
+  gradient with an expected-loss baseline, for parity testing and for
+  regimes where dense compute is wasteful.
+
+Expert sharding across a TPU mesh (M ~ 50, BASELINE.md config #4) lives in
+``esac_tpu.parallel``; the functions here are its per-shard body.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from esac_tpu.ransac.config import RansacConfig
+from esac_tpu.ransac.kernel import generate_hypotheses, pose_loss
+from esac_tpu.ransac.refine import refine_soft_inliers
+from esac_tpu.ransac.sampling import sample_expert_indices
+from esac_tpu.ransac.scoring import reprojection_error_map, soft_inlier_score
+
+
+def _per_expert_hypotheses(key, coords_all, pixels, f, c, cfg):
+    """cfg.n_hyps hypotheses per expert. coords_all: (M, N, 3).
+
+    Returns rvecs, tvecs (M, n_hyps, 3) and scores (M, n_hyps), each
+    hypothesis scored on its own expert's coordinate map.
+    """
+    M = coords_all.shape[0]
+    keys = jax.random.split(key, M)
+    rvecs, tvecs = jax.vmap(
+        lambda k, co: generate_hypotheses(k, co, pixels, f, c, cfg)
+    )(keys, coords_all)
+    errors = jax.vmap(
+        lambda rv, tv, co: reprojection_error_map(rv, tv, co, pixels, f, c)
+    )(rvecs, tvecs, coords_all)
+    scores = soft_inlier_score(errors, cfg.tau, cfg.beta)
+    return rvecs, tvecs, scores
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def esac_infer(
+    key: jax.Array,
+    gating_logits: jnp.ndarray,
+    coords_all: jnp.ndarray,
+    pixels: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    cfg: RansacConfig = RansacConfig(),
+) -> dict:
+    """Inference over M experts: global argmax of soft-inlier score.
+
+    gating_logits: (M,) — reported (and usable for expert top-k pruning by
+    the caller), but selection is by consensus score: with all experts
+    computed, the best-supported hypothesis wins regardless of the gate,
+    which strictly dominates the reference's drawn-subset argmax.
+
+    Returns dict with 'rvec', 'tvec', 'expert' (winning expert index),
+    'scores' (M, n_hyps), 'gating_probs'.
+    """
+    rvecs, tvecs, scores = _per_expert_hypotheses(key, coords_all, pixels, f, c, cfg)
+    M, nh = scores.shape
+    flat = jnp.argmax(scores.reshape(-1))
+    m_star, j_star = flat // nh, flat % nh
+    rvec, tvec = refine_soft_inliers(
+        rvecs[m_star, j_star],
+        tvecs[m_star, j_star],
+        coords_all[m_star],
+        pixels,
+        f,
+        c,
+        cfg.tau,
+        cfg.beta,
+        iters=cfg.refine_iters,
+    )
+    return {
+        "rvec": rvec,
+        "tvec": tvec,
+        "expert": m_star,
+        "scores": scores,
+        "gating_probs": jax.nn.softmax(gating_logits),
+        "inlier_frac": scores[m_star, j_star] / pixels.shape[0],
+    }
+
+
+def _expected_losses_per_expert(rvecs, tvecs, scores, coords_all, pixels, f, c, R_gt, t_gt, cfg):
+    """Within-expert softmax-selection expectation of the refined pose loss.
+
+    Returns (M,) expected losses and (M, nh) per-hypothesis losses.
+    """
+
+    def one_expert(rv, tv, sc, co):
+        probs = jax.nn.softmax(cfg.alpha * sc)
+        refine = jax.vmap(
+            lambda r, t: refine_soft_inliers(
+                r, t, co, pixels, f, c, cfg.tau, cfg.beta,
+                iters=cfg.train_refine_iters,
+            )
+        )
+        rv_r, tv_r = refine(rv, tv)
+        losses = jax.vmap(lambda r, t: pose_loss(r, t, R_gt, t_gt, cfg))(rv_r, tv_r)
+        return jnp.sum(probs * losses), losses
+
+    return jax.vmap(one_expert)(rvecs, tvecs, scores, coords_all)
+
+
+@partial(jax.jit, static_argnames=("cfg", "mode"))
+def esac_train_loss(
+    key: jax.Array,
+    gating_logits: jnp.ndarray,
+    coords_all: jnp.ndarray,
+    pixels: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    R_gt: jnp.ndarray,
+    t_gt: jnp.ndarray,
+    cfg: RansacConfig = RansacConfig(),
+    mode: str = "dense",
+) -> tuple[jnp.ndarray, dict]:
+    """End-to-end expected pose loss, differentiable wrt coords AND gating.
+
+    dense:   loss = sum_m softmax(gating)_m * E_j[pose_loss]  — exact gating
+             gradient, no sampling variance (TPU-native default).
+    sampled: reference-parity estimator — experts drawn per hypothesis,
+             REINFORCE (score-function) term with expected-loss baseline
+             carries the gating gradient (SURVEY.md §0 training stage 3).
+    """
+    g = jax.nn.softmax(gating_logits)
+
+    if mode == "dense":
+        k_hyp, _ = jax.random.split(key)
+        rvecs, tvecs, scores = _per_expert_hypotheses(
+            k_hyp, coords_all, pixels, f, c, cfg
+        )
+        exp_losses, losses = _expected_losses_per_expert(
+            rvecs, tvecs, scores, coords_all, pixels, f, c, R_gt, t_gt, cfg
+        )
+        total = jnp.sum(g * exp_losses)
+        aux = {
+            "expected_loss": total,
+            "per_expert_loss": exp_losses,
+            "gating_probs": g,
+            "scores": scores,
+        }
+        return total, aux
+
+    if mode != "sampled":
+        raise ValueError(f"unknown mode {mode!r}")
+
+    k_draw, k_hyp = jax.random.split(key)
+    M, N = coords_all.shape[0], coords_all.shape[1]
+    experts = sample_expert_indices(k_draw, g, cfg.n_hyps)  # (n_hyps,)
+    coords_sel = coords_all[experts]  # (n_hyps, N, 3)
+
+    # One hypothesis per drawn expert map: reuse the single-expert generator
+    # by folding the hypothesis index into the key.
+    from esac_tpu.geometry.pnp import solve_pnp_minimal
+    from esac_tpu.ransac.sampling import sample_correspondence_sets
+
+    idx = sample_correspondence_sets(k_hyp, cfg.n_hyps, N)  # (n_hyps, 4)
+    X4 = jnp.take_along_axis(coords_sel, idx[:, :, None], axis=1)
+    x4 = pixels[idx]
+    rvecs, tvecs = jax.vmap(
+        lambda Xi, xi: solve_pnp_minimal(Xi, xi, f, c, polish_iters=cfg.polish_iters)
+    )(X4, x4)
+
+    # Score each hypothesis on its own expert's map.
+    from esac_tpu.geometry.camera import reprojection_errors
+    from esac_tpu.geometry.rotations import rodrigues
+
+    errors = jax.vmap(
+        lambda rv, tv, co: reprojection_errors(rodrigues(rv), tv, co, pixels, f, c)
+    )(rvecs, tvecs, coords_sel)
+    scores = soft_inlier_score(errors, cfg.tau, cfg.beta)
+    probs = jax.nn.softmax(cfg.alpha * scores)
+
+    refine = jax.vmap(
+        lambda rv, tv, co: refine_soft_inliers(
+            rv, tv, co, pixels, f, c, cfg.tau, cfg.beta,
+            iters=cfg.train_refine_iters,
+        )
+    )
+    rvecs_r, tvecs_r = refine(rvecs, tvecs, coords_sel)
+    losses = jax.vmap(lambda rv, tv: pose_loss(rv, tv, R_gt, t_gt, cfg))(
+        rvecs_r, tvecs_r
+    )
+    expected = jnp.sum(probs * losses)
+
+    # Score-function estimator for the discrete expert draw:
+    # grad_phi E ~ sum_j p_j * (loss_j - b) * grad_phi log g[e_j].
+    # Baseline choice matters: the selection-weighted expectation itself makes
+    # p_j*(loss_j - b) vanish by construction (the softmax concentrates where
+    # loss ~ b), killing the signal; the *unweighted* mean loss keeps good
+    # hypotheses strongly negative and garbage ones positive, which is the
+    # variant that empirically recovers the true gating direction in a
+    # handful of draws.
+    log_g = jnp.log(g + 1e-12)
+    baseline = jax.lax.stop_gradient(jnp.mean(losses))
+    weights = jax.lax.stop_gradient(probs * (losses - baseline))
+    reinforce = jnp.sum(weights * log_g[experts])
+    # Add only the *gradient* of the REINFORCE term, not its value.
+    total = expected + reinforce - jax.lax.stop_gradient(reinforce)
+
+    aux = {
+        "expected_loss": expected,
+        "drawn_experts": experts,
+        "gating_probs": g,
+        "scores": scores,
+    }
+    return total, aux
