@@ -1,0 +1,31 @@
+"""Device-mesh parallelism for expert ensembles and frame batches.
+
+The reference has NO distributed layer — a single process with OpenMP threads
+(SURVEY.md §2 "Parallelism strategies", §5 "Distributed communication
+backend").  The TPU-native scaling axes are:
+
+- **EP (expert parallel)**: experts sharded over the mesh's ``expert`` axis;
+  the one real cross-chip collective is the argmax all-reduce that selects
+  the globally best hypothesis (BASELINE.md config #4: "50 experts sharded
+  over v4-8, all-reduce winning pose") — implemented with ``shard_map`` +
+  ``lax.pmax``/``lax.psum`` so it rides ICI.
+- **DP (data parallel)**: frame batches sharded over the ``data`` axis
+  (BASELINE.md config #5, streaming relocalization) via ``NamedSharding``;
+  XLA inserts gradient psums.
+- **Hypothesis parallel**: ``vmap`` *within* a chip — thousands of
+  hypotheses per XLA dispatch; this axis never needs communication.
+
+TP / PP / SP / CP / ring attention / Ulysses: **not applicable** to this
+workload — there is no sequence axis and no layer too large for one chip;
+see PARALLELISM.md at the repo root for the explicit mapping.
+"""
+
+from esac_tpu.parallel.mesh import make_mesh, expert_sharding, batch_sharding
+from esac_tpu.parallel.esac_sharded import esac_infer_sharded
+
+__all__ = [
+    "make_mesh",
+    "expert_sharding",
+    "batch_sharding",
+    "esac_infer_sharded",
+]

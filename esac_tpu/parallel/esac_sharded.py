@@ -1,0 +1,79 @@
+"""Expert-sharded ESAC inference: the winning-pose argmax all-reduce.
+
+BASELINE.md config #4: experts sharded over the mesh; every device generates
+and scores hypotheses for its local experts only, refines its local best,
+and the globally best pose is selected by an argmax all-reduce over the
+``expert`` axis — ``lax.pmax`` on the score, deterministic tie-break on the
+global expert index, ``lax.psum`` of the masked winner pose.  This is the
+single real cross-chip collective of the workload (SURVEY.md §2), expressed
+with ``shard_map`` so the communication pattern is explicit and rides ICI.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from esac_tpu.ransac.config import RansacConfig
+from esac_tpu.ransac.esac import _per_expert_hypotheses
+from esac_tpu.ransac.refine import refine_soft_inliers
+
+
+def esac_infer_sharded(
+    mesh: Mesh,
+    key: jax.Array,
+    coords_all: jnp.ndarray,
+    pixels: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    cfg: RansacConfig = RansacConfig(),
+):
+    """Sharded multi-expert inference. coords_all: (M, N, 3), M divisible by
+    the mesh's ``expert`` axis size.  Returns (rvec, tvec, expert, score) —
+    replicated on all devices.
+    """
+    n_exp_shards = mesh.shape["expert"]
+    M = coords_all.shape[0]
+    if M % n_exp_shards != 0:
+        raise ValueError(f"M={M} not divisible by expert shards {n_exp_shards}")
+    m_local = M // n_exp_shards
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P("expert"), P()),
+        out_specs=(P(), P(), P(), P()),
+    )
+    def body(k, coords_local, px):
+        # Every shard derives its own key from its expert-shard position so
+        # hypothesis draws differ across shards deterministically.
+        shard_id = jax.lax.axis_index("expert")
+        k_local = jax.random.fold_in(k, shard_id)
+        rvecs, tvecs, scores = _per_expert_hypotheses(
+            k_local, coords_local, px, f, c, cfg
+        )  # (m_local, nh, 3), (m_local, nh)
+
+        # Local winner + full refinement (each device refines one pose).
+        flat = jnp.argmax(scores.reshape(-1))
+        mi, j = flat // scores.shape[1], flat % scores.shape[1]
+        rvec, tvec = refine_soft_inliers(
+            rvecs[mi, j], tvecs[mi, j], coords_local[mi], px, f, c,
+            cfg.tau, cfg.beta, iters=cfg.refine_iters,
+        )
+        local_score = scores[mi, j]
+        global_expert = shard_id * m_local + mi
+
+        # Argmax all-reduce over the expert axis: pmax the score, break ties
+        # toward the smallest expert index, psum the masked winner.
+        best_score = jax.lax.pmax(local_score, "expert")
+        tie_idx = jnp.where(local_score >= best_score, global_expert, M)
+        win_idx = jax.lax.pmin(tie_idx, "expert")
+        is_winner = (global_expert == win_idx).astype(rvec.dtype)
+        rvec_g = jax.lax.psum(rvec * is_winner, "expert")
+        tvec_g = jax.lax.psum(tvec * is_winner, "expert")
+        return rvec_g, tvec_g, win_idx, best_score
+
+    return jax.jit(body)(key, coords_all, pixels)

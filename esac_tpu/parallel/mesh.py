@@ -1,0 +1,41 @@
+"""Mesh construction and canonical shardings.
+
+Axis convention: ``("data", "expert")`` — data-parallel frames on the outer
+axis (DCN-friendly), expert shards on the inner axis (ICI-friendly), so the
+winning-pose all-reduce and any expert-map gathers ride the faster fabric,
+following the standard mesh layout recipe (outer = slower interconnect).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_data: int = 1, n_expert: int | None = None) -> Mesh:
+    """Build a ("data", "expert") mesh over the available devices."""
+    n_dev = jax.device_count()
+    if n_expert is None:
+        n_expert = n_dev // n_data
+    if n_data * n_expert != n_dev:
+        raise ValueError(
+            f"mesh {n_data}x{n_expert} != device count {n_dev}"
+        )
+    devices = mesh_utils.create_device_mesh((n_data, n_expert))
+    return Mesh(devices, axis_names=("data", "expert"))
+
+
+def expert_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (expert) axis: coords_all (M, ...), stacked params."""
+    return NamedSharding(mesh, P("expert"))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) axis of per-frame data."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
