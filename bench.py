@@ -1,0 +1,98 @@
+"""Benchmark: pose hypotheses/sec/chip, jax (TPU) vs the cpp reference path.
+
+Prints ONE JSON line:
+  {"metric": "pose_hypotheses_per_sec_per_chip", "value": <jax hyps/s>,
+   "unit": "hyps/s", "vs_baseline": <jax / cpp ratio>}
+
+Measures the FULL per-frame hypothesis pipeline at the reference's standard
+configuration (BASELINE.md config #1: 256 hypotheses, 80x60 coordinate grid):
+sample -> minimal P3P solve -> soft-inlier score over all 4800 cells ->
+argmax select -> IRLS refine.  The cpp baseline is the self-contained
+C++/OpenMP backend (esac_cpp/), the stand-in for the reference's
+CPU-extension path measured on this host; the north-star target is >=20x
+(BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from esac_tpu.data import CAMERA_F, make_correspondence_frame
+from esac_tpu.ransac import RansacConfig, dsac_infer
+
+N_HYPS = 256
+BATCH = 16          # frames vmapped per dispatch to saturate the chip
+REPEATS = 20
+C = (320.0, 240.0)
+
+
+def bench_jax() -> float:
+    cfg = RansacConfig(n_hyps=N_HYPS)
+    keys = jax.random.split(jax.random.key(0), BATCH)
+    frames = [
+        make_correspondence_frame(k, noise=0.01, outlier_frac=0.3) for k in keys
+    ]
+    coords = jnp.stack([f["coords"] for f in frames])
+    pixels = jnp.stack([f["pixels"] for f in frames])
+    f32 = jnp.float32(CAMERA_F)
+    c = jnp.asarray(C)
+
+    fn = jax.jit(
+        jax.vmap(lambda k, co, px: dsac_infer(k, co, px, f32, c, cfg))
+    )
+    rkeys = jax.random.split(jax.random.key(1), BATCH)
+    out = fn(rkeys, coords, pixels)
+    jax.block_until_ready(out["rvec"])  # compile + warm
+    t0 = time.perf_counter()
+    for i in range(REPEATS):
+        out = fn(jax.random.split(jax.random.key(2 + i), BATCH), coords, pixels)
+    jax.block_until_ready(out["rvec"])
+    dt = time.perf_counter() - t0
+    return REPEATS * BATCH * N_HYPS / dt
+
+
+def bench_cpp() -> float | None:
+    try:
+        from esac_tpu.backends import cpp_available, esac_infer_cpp
+
+        if not cpp_available():
+            return None
+        frame = make_correspondence_frame(
+            jax.random.key(0), noise=0.01, outlier_frac=0.3
+        )
+        co = np.asarray(frame["coords"])
+        px = np.asarray(frame["pixels"])
+        esac_infer_cpp(co, px, CAMERA_F, C, n_hyps=N_HYPS, seed=0)  # warm
+        reps = 5
+        t0 = time.perf_counter()
+        for i in range(reps):
+            esac_infer_cpp(co, px, CAMERA_F, C, n_hyps=N_HYPS, seed=i)
+        dt = time.perf_counter() - t0
+        return reps * N_HYPS / dt
+    except Exception:
+        return None
+
+
+def main() -> None:
+    jax_rate = bench_jax()
+    cpp_rate = bench_cpp()
+    vs = (jax_rate / cpp_rate) if cpp_rate else None
+    print(
+        json.dumps(
+            {
+                "metric": "pose_hypotheses_per_sec_per_chip",
+                "value": round(jax_rate, 1),
+                "unit": "hyps/s",
+                "vs_baseline": round(vs, 2) if vs is not None else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,443 @@
+// C++ reference backend: the hypothesis loop on the host CPU.
+//
+// Re-implementation of what the reference's torch C++ extension does
+// (SURVEY.md §2 #3-5, §3.5): OpenMP loop over hypotheses, per-thread RNG,
+// 4-point minimal PnP (Grunert P3P quartic + 4th-point disambiguation),
+// soft-inlier scoring, argmax selection, iterative weighted Gauss-Newton
+// refinement.  Self-contained — no OpenCV (the reference links OpenCV for
+// solvePnP/Rodrigues; this file carries its own P3P, triad alignment and
+// 6x6 Cholesky instead so the backend builds anywhere).
+//
+// This is the measured `--backend cpp` baseline for the >=20x hypotheses/sec
+// target (BASELINE.md); it is correctness- and speed-representative of the
+// reference's CPU path, not a copy of it.
+
+#include <cmath>
+#include <complex>
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace {
+
+using cd = std::complex<double>;
+
+// ---------------------------------------------------------------- RNG ----
+// Per-hypothesis deterministic stream: splitmix64 seeded by (seed, hyp).
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed) {}
+  uint64_t next() {
+    uint64_t z = (s += 0x9e3779b97f4a7c15ull);
+    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+    z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+    return z ^ (z >> 31);
+  }
+  // uniform int in [0, n)
+  int below(int n) { return static_cast<int>(next() % static_cast<uint64_t>(n)); }
+};
+
+// ------------------------------------------------------------- algebra ----
+inline void cross3(const double a[3], const double b[3], double out[3]) {
+  out[0] = a[1] * b[2] - a[2] * b[1];
+  out[1] = a[2] * b[0] - a[0] * b[2];
+  out[2] = a[0] * b[1] - a[1] * b[0];
+}
+inline double dot3(const double a[3], const double b[3]) {
+  return a[0] * b[0] + a[1] * b[1] + a[2] * b[2];
+}
+inline double norm3(const double a[3]) { return std::sqrt(dot3(a, a)); }
+inline void normalize3(double a[3]) {
+  double n = norm3(a);
+  if (n > 1e-12) {
+    a[0] /= n; a[1] /= n; a[2] /= n;
+  }
+}
+
+// Roots of q4 v^4 + q3 v^3 + q2 v^2 + q1 v + q0 (Ferrari, complex).
+void solve_quartic(const double q[5], cd roots[4]) {
+  double q4 = q[0];
+  double mx = 0.0;
+  for (int i = 0; i < 5; i++) mx = std::max(mx, std::fabs(q[i]));
+  if (mx < 1e-18) { for (int i = 0; i < 4; i++) roots[i] = 0.0; return; }
+  if (std::fabs(q4) < 1e-12 * mx) q4 = (q4 < 0 ? -1e-12 : 1e-12) * mx;
+  cd a3 = q[1] / q4, a2 = q[2] / q4, a1 = q[3] / q4, a0 = q[4] / q4;
+  cd p = a2 - a3 * a3 * 3.0 / 8.0;
+  cd qq = a1 - a3 * a2 / 2.0 + a3 * a3 * a3 / 8.0;
+  cd r = a0 - a3 * a1 / 4.0 + a3 * a3 * a2 / 16.0 - a3 * a3 * a3 * a3 * 3.0 / 256.0;
+  // Resolvent cubic m^3 + p m^2 + (p^2-4r)/4 m - q^2/8 = 0 via Cardano.
+  cd B = p, C = (p * p - 4.0 * r) / 4.0, D = -qq * qq / 8.0;
+  cd P = C - B * B / 3.0;
+  cd Q = B * B * B * 2.0 / 27.0 - B * C / 3.0 + D;
+  cd S = std::sqrt(Q * Q / 4.0 + P * P * P / 27.0);
+  cd z1 = -Q / 2.0 + S, z2 = -Q / 2.0 - S;
+  cd z = (std::abs(z1) >= std::abs(z2)) ? z1 : z2;
+  cd U = (std::abs(z) < 1e-30) ? cd(0.0) : std::pow(z, 1.0 / 3.0);
+  cd W = (std::abs(U) < 1e-30) ? cd(0.0) : -P / (3.0 * U);
+  cd m_best = 0.0;
+  const cd omega(-0.5, std::sqrt(3.0) / 2.0);
+  cd w1 = 1.0;
+  for (int k = 0; k < 3; k++) {
+    cd m = w1 * U + std::conj(w1) * W - B / 3.0;
+    if (std::abs(m) > std::abs(m_best)) m_best = m;
+    w1 *= omega;
+  }
+  cd s = std::sqrt(2.0 * m_best);
+  cd qs = (std::abs(s) < 1e-30) ? cd(0.0) : qq / (2.0 * s);
+  cd t1 = p / 2.0 + m_best - qs;
+  cd t2 = p / 2.0 + m_best + qs;
+  cd d1 = std::sqrt(s * s - 4.0 * t1);
+  cd d2 = std::sqrt(s * s - 4.0 * t2);
+  roots[0] = (-s + d1) / 2.0 - a3 / 4.0;
+  roots[1] = (-s - d1) / 2.0 - a3 / 4.0;
+  roots[2] = (s + d2) / 2.0 - a3 / 4.0;
+  roots[3] = (s - d2) / 2.0 - a3 / 4.0;
+}
+
+// Rigid alignment of 3 exact correspondences: orthonormal-triad method.
+// Y ~= R X + t.  Returns false for degenerate (collinear) triples.
+bool triad_align(const double X[3][3], const double Y[3][3], double R[9], double t[3]) {
+  double ux[3] = {X[1][0] - X[0][0], X[1][1] - X[0][1], X[1][2] - X[0][2]};
+  double vx[3] = {X[2][0] - X[0][0], X[2][1] - X[0][1], X[2][2] - X[0][2]};
+  double uy[3] = {Y[1][0] - Y[0][0], Y[1][1] - Y[0][1], Y[1][2] - Y[0][2]};
+  double vy[3] = {Y[2][0] - Y[0][0], Y[2][1] - Y[0][1], Y[2][2] - Y[0][2]};
+  double nx[3], ny[3];
+  cross3(ux, vx, nx);
+  cross3(uy, vy, ny);
+  if (norm3(nx) < 1e-12 || norm3(ny) < 1e-12) return false;
+  // Basis {e1, e2, e3} for each frame.
+  double e1x[3] = {ux[0], ux[1], ux[2]};
+  normalize3(e1x);
+  double e3x[3] = {nx[0], nx[1], nx[2]};
+  normalize3(e3x);
+  double e2x[3];
+  cross3(e3x, e1x, e2x);
+  double e1y[3] = {uy[0], uy[1], uy[2]};
+  normalize3(e1y);
+  double e3y[3] = {ny[0], ny[1], ny[2]};
+  normalize3(e3y);
+  double e2y[3];
+  cross3(e3y, e1y, e2y);
+  // R = By * Bx^T with columns e1,e2,e3.
+  double Bx[9] = {e1x[0], e2x[0], e3x[0], e1x[1], e2x[1], e3x[1], e1x[2], e2x[2], e3x[2]};
+  double By[9] = {e1y[0], e2y[0], e3y[0], e1y[1], e2y[1], e3y[1], e1y[2], e2y[2], e3y[2]};
+  for (int i = 0; i < 3; i++)
+    for (int j = 0; j < 3; j++) {
+      double s = 0;
+      for (int k = 0; k < 3; k++) s += By[i * 3 + k] * Bx[j * 3 + k];
+      R[i * 3 + j] = s;
+    }
+  double Xc[3] = {(X[0][0] + X[1][0] + X[2][0]) / 3.0,
+                  (X[0][1] + X[1][1] + X[2][1]) / 3.0,
+                  (X[0][2] + X[1][2] + X[2][2]) / 3.0};
+  double Yc[3] = {(Y[0][0] + Y[1][0] + Y[2][0]) / 3.0,
+                  (Y[0][1] + Y[1][1] + Y[2][1]) / 3.0,
+                  (Y[0][2] + Y[1][2] + Y[2][2]) / 3.0};
+  for (int i = 0; i < 3; i++)
+    t[i] = Yc[i] - (R[i * 3] * Xc[0] + R[i * 3 + 1] * Xc[1] + R[i * 3 + 2] * Xc[2]);
+  return true;
+}
+
+// Grunert P3P + 4th point disambiguation.  Returns best (R, t) or false.
+bool solve_p3p4(const double X[4][3], const double px[4][2], double f, double cx,
+                double cy, double R[9], double t[3]) {
+  // Unit bearings.
+  double b[4][3];
+  for (int i = 0; i < 4; i++) {
+    b[i][0] = (px[i][0] - cx) / f;
+    b[i][1] = (px[i][1] - cy) / f;
+    b[i][2] = 1.0;
+    normalize3(b[i]);
+  }
+  double ca = dot3(b[1], b[2]), cb = dot3(b[0], b[2]), cg = dot3(b[0], b[1]);
+  double d01[3] = {X[0][0] - X[1][0], X[0][1] - X[1][1], X[0][2] - X[1][2]};
+  double d02[3] = {X[0][0] - X[2][0], X[0][1] - X[2][1], X[0][2] - X[2][2]};
+  double d12[3] = {X[1][0] - X[2][0], X[1][1] - X[2][1], X[1][2] - X[2][2]};
+  double asq = dot3(d12, d12), bsq = dot3(d02, d02), csq = dot3(d01, d01);
+  if (asq < 1e-12 || bsq < 1e-12 || csq < 1e-12) return false;
+  double w = asq - csq;
+  double d1 = 2 * bsq * ca, d0 = -2 * bsq * cg;
+  double e2 = w - bsq, e1 = -2 * w * cb, e0 = bsq + w;
+  double g2 = -csq, g1 = 2 * csq * cb, g0 = bsq - csq;
+  double E2[5] = {e2 * e2, 2 * e2 * e1, 2 * e2 * e0 + e1 * e1, 2 * e1 * e0, e0 * e0};
+  double ED[5] = {0, e2 * d1, e2 * d0 + e1 * d1, e1 * d0 + e0 * d1, e0 * d0};
+  double A2 = d1 * d1, B2 = 2 * d1 * d0, C2 = d0 * d0;
+  double GD2[5] = {g2 * A2, g2 * B2 + g1 * A2, g2 * C2 + g1 * B2 + g0 * A2,
+                   g1 * C2 + g0 * B2, g0 * C2};
+  double Q[5];
+  for (int i = 0; i < 5; i++) Q[i] = bsq * E2[i] + 2 * bsq * cg * ED[i] + GD2[i];
+  cd roots[4];
+  solve_quartic(Q, roots);
+
+  double best_err = 1e30;
+  bool found = false;
+  for (int k = 0; k < 4; k++) {
+    if (std::fabs(roots[k].imag()) > 1e-4 * (1.0 + std::fabs(roots[k].real())))
+      continue;
+    double v = roots[k].real();
+    double Dv = d1 * v + d0;
+    if (std::fabs(Dv) < 1e-12) continue;
+    double Ev = (e2 * v + e1) * v + e0;
+    double u = -Ev / Dv;
+    double denom = 1.0 + v * v - 2.0 * v * cb;
+    if (denom < 1e-12) continue;
+    double s1 = std::sqrt(bsq / denom);
+    double s2 = u * s1, s3 = v * s1;
+    if (s1 <= 0.05 || s2 <= 0.05 || s3 <= 0.05) continue;
+    double Y[3][3];
+    for (int j = 0; j < 3; j++) {
+      double s = (j == 0) ? s1 : (j == 1 ? s2 : s3);
+      for (int d = 0; d < 3; d++) Y[j][d] = s * b[j][d];
+    }
+    double X3[3][3];
+    std::memcpy(X3, X, sizeof(X3));
+    double Rk[9], tk[3];
+    if (!triad_align(X3, Y, Rk, tk)) continue;
+    // 4th-point reprojection error.
+    double Yp[3];
+    for (int i = 0; i < 3; i++)
+      Yp[i] = Rk[i * 3] * X[3][0] + Rk[i * 3 + 1] * X[3][1] + Rk[i * 3 + 2] * X[3][2] + tk[i];
+    if (Yp[2] < 0.05) continue;
+    double uu = f * Yp[0] / Yp[2] + cx, vv = f * Yp[1] / Yp[2] + cy;
+    double err = std::hypot(uu - px[3][0], vv - px[3][1]);
+    if (err < best_err) {
+      best_err = err;
+      std::memcpy(R, Rk, sizeof(Rk));
+      std::memcpy(t, tk, sizeof(tk));
+      found = true;
+    }
+  }
+  return found;
+}
+
+// Soft-inlier score of a pose over all cells.
+double score_pose(const double R[9], const double t[3], const float* coords,
+                  const float* pixels, int n, double f, double cx, double cy,
+                  double tau, double beta) {
+  double score = 0;
+  for (int i = 0; i < n; i++) {
+    double X0 = coords[i * 3], X1 = coords[i * 3 + 1], X2 = coords[i * 3 + 2];
+    double z = R[6] * X0 + R[7] * X1 + R[8] * X2 + t[2];
+    double err;
+    if (z < 0.1) {
+      err = 1000.0;
+    } else {
+      double x = R[0] * X0 + R[1] * X1 + R[2] * X2 + t[0];
+      double y = R[3] * X0 + R[4] * X1 + R[5] * X2 + t[1];
+      double u = f * x / z + cx, v = f * y / z + cy;
+      err = std::hypot(u - pixels[i * 2], v - pixels[i * 2 + 1]);
+    }
+    score += 1.0 / (1.0 + std::exp(-beta * (tau - err)));
+  }
+  return score;
+}
+
+// One weighted Gauss-Newton step on (R, t) with soft-inlier weights.
+// Left-multiplicative rotation update R <- exp(delta) R.
+void gn_step(double R[9], double t[3], const float* coords, const float* pixels,
+             int n, double f, double cx, double cy, double tau, double beta) {
+  double A[36] = {0};
+  double g[6] = {0};
+  for (int i = 0; i < n; i++) {
+    double X0 = coords[i * 3], X1 = coords[i * 3 + 1], X2 = coords[i * 3 + 2];
+    double Y[3] = {R[0] * X0 + R[1] * X1 + R[2] * X2 + t[0],
+                   R[3] * X0 + R[4] * X1 + R[5] * X2 + t[1],
+                   R[6] * X0 + R[7] * X1 + R[8] * X2 + t[2]};
+    if (Y[2] < 0.1) continue;
+    double z = Y[2];
+    double u = f * Y[0] / z + cx, v = f * Y[1] / z + cy;
+    double ru = u - pixels[i * 2], rv = v - pixels[i * 2 + 1];
+    double err = std::hypot(ru, rv);
+    double wgt = 1.0 / (1.0 + std::exp(-beta * (tau - err)));
+    if (wgt < 1e-4) continue;
+    // du/dY, dv/dY
+    double Ju[3] = {f / z, 0, -f * Y[0] / (z * z)};
+    double Jv[3] = {0, f / z, -f * Y[1] / (z * z)};
+    // dY/d[delta(3), t(3)]: dY/ddelta = -skew(Y - t), dY/dt = I.
+    double W[3] = {Y[0] - t[0], Y[1] - t[1], Y[2] - t[2]};
+    // column-major construction of J rows for u and v: 6 entries each.
+    double rowu[6], rowv[6];
+    // -skew(W) columns: d/ddelta_k (exp(delta) W) = e_k x W
+    // (e_k x W) components:
+    double ex[3] = {0, -W[2], W[1]};   // e0 x W? careful: e0 x W = (0*Wz-0*Wy, ...)
+    double ey[3] = {W[2], 0, -W[0]};
+    double ez[3] = {-W[1], W[0], 0};
+    // Actually e0 x W = (0,0,0)x? e0=(1,0,0): e0 x W = (0*W2-0*W1, 0*W0-1*W2, 1*W1-0*W0) = (0,-W2,W1). OK == ex.
+    rowu[0] = Ju[0] * ex[0] + Ju[1] * ex[1] + Ju[2] * ex[2];
+    rowu[1] = Ju[0] * ey[0] + Ju[1] * ey[1] + Ju[2] * ey[2];
+    rowu[2] = Ju[0] * ez[0] + Ju[1] * ez[1] + Ju[2] * ez[2];
+    rowu[3] = Ju[0]; rowu[4] = Ju[1]; rowu[5] = Ju[2];
+    rowv[0] = Jv[0] * ex[0] + Jv[1] * ex[1] + Jv[2] * ex[2];
+    rowv[1] = Jv[0] * ey[0] + Jv[1] * ey[1] + Jv[2] * ey[2];
+    rowv[2] = Jv[0] * ez[0] + Jv[1] * ez[1] + Jv[2] * ez[2];
+    rowv[3] = Jv[0]; rowv[4] = Jv[1]; rowv[5] = Jv[2];
+    for (int a = 0; a < 6; a++) {
+      g[a] += wgt * (rowu[a] * ru + rowv[a] * rv);
+      for (int bI = 0; bI < 6; bI++)
+        A[a * 6 + bI] += wgt * (rowu[a] * rowu[bI] + rowv[a] * rowv[bI]);
+    }
+  }
+  // Levenberg damping + 6x6 Cholesky solve.
+  double trace = 0;
+  for (int a = 0; a < 6; a++) trace += A[a * 6 + a];
+  double mu = 1e-4 * (trace / 6.0 + 1e-9);
+  for (int a = 0; a < 6; a++) A[a * 6 + a] += mu;
+  double L[36] = {0};
+  for (int i = 0; i < 6; i++) {
+    for (int j = 0; j <= i; j++) {
+      double s = A[i * 6 + j];
+      for (int k = 0; k < j; k++) s -= L[i * 6 + k] * L[j * 6 + k];
+      if (i == j) {
+        if (s <= 0) return;  // singular; skip step
+        L[i * 6 + i] = std::sqrt(s);
+      } else {
+        L[i * 6 + j] = s / L[j * 6 + j];
+      }
+    }
+  }
+  double yv[6], dx[6];
+  for (int i = 0; i < 6; i++) {
+    double s = g[i];
+    for (int k = 0; k < i; k++) s -= L[i * 6 + k] * yv[k];
+    yv[i] = s / L[i * 6 + i];
+  }
+  for (int i = 5; i >= 0; i--) {
+    double s = yv[i];
+    for (int k = i + 1; k < 6; k++) s -= L[k * 6 + i] * dx[k];
+    dx[i] = s / L[i * 6 + i];
+  }
+  // Update: delta = -dx[0:3] (rotation), t -= dx[3:6].
+  double dr[3] = {-dx[0], -dx[1], -dx[2]};
+  double th = norm3(dr);
+  double Rd[9] = {1, 0, 0, 0, 1, 0, 0, 0, 1};
+  if (th > 1e-12) {
+    double k[3] = {dr[0] / th, dr[1] / th, dr[2] / th};
+    double ct = std::cos(th), st = std::sin(th), vt = 1 - ct;
+    Rd[0] = ct + k[0] * k[0] * vt;
+    Rd[1] = k[0] * k[1] * vt - k[2] * st;
+    Rd[2] = k[0] * k[2] * vt + k[1] * st;
+    Rd[3] = k[1] * k[0] * vt + k[2] * st;
+    Rd[4] = ct + k[1] * k[1] * vt;
+    Rd[5] = k[1] * k[2] * vt - k[0] * st;
+    Rd[6] = k[2] * k[0] * vt - k[1] * st;
+    Rd[7] = k[2] * k[1] * vt + k[0] * st;
+    Rd[8] = ct + k[2] * k[2] * vt;
+  }
+  double Rn[9];
+  for (int i = 0; i < 3; i++)
+    for (int j = 0; j < 3; j++) {
+      double s = 0;
+      for (int kk = 0; kk < 3; kk++) s += Rd[i * 3 + kk] * R[kk * 3 + j];
+      Rn[i * 3 + j] = s;
+    }
+  std::memcpy(R, Rn, sizeof(Rn));
+  t[0] -= dx[3];
+  t[1] -= dx[4];
+  t[2] -= dx[5];
+}
+
+}  // namespace
+
+extern "C" {
+
+// The hypothesis loop.  coords: (n_cells, 3) float32, pixels: (n_cells, 2).
+// Outputs: best pose out_R (row-major 3x3), out_t (3), out_score, and the
+// full per-hypothesis score array (n_hyps) for diagnostics/equivalence tests.
+// Returns the number of hypotheses whose minimal solve succeeded.
+int esac_cpp_infer(const float* coords, const float* pixels, int n_cells,
+                   float f, float cx, float cy, int n_hyps, float tau,
+                   float beta, int refine_iters, uint64_t seed, double* out_R,
+                   double* out_t, double* out_score, double* out_scores) {
+  int n_valid = 0;
+  double best_score = -1.0;
+  double best_R[9], best_t[3];
+#ifdef _OPENMP
+#pragma omp parallel
+#endif
+  {
+    double loc_best = -1.0;
+    double loc_R[9], loc_t[3];
+    int loc_valid = 0;
+#ifdef _OPENMP
+#pragma omp for schedule(static)
+#endif
+    for (int h = 0; h < n_hyps; h++) {
+      Rng rng(seed * 0x9e3779b97f4a7c15ull + static_cast<uint64_t>(h));
+      // 4 distinct cells (retry up to 16 times, like the reference's
+      // max_tries rejection loop).
+      int idx[4];
+      double R[9], t[3];
+      bool ok = false;
+      for (int attempt = 0; attempt < 16 && !ok; attempt++) {
+        for (int j = 0; j < 4; j++) {
+          bool dup = true;
+          while (dup) {
+            idx[j] = rng.below(n_cells);
+            dup = false;
+            for (int k = 0; k < j; k++) dup |= (idx[k] == idx[j]);
+          }
+        }
+        double X[4][3], px[4][2];
+        for (int j = 0; j < 4; j++) {
+          for (int d = 0; d < 3; d++) X[j][d] = coords[idx[j] * 3 + d];
+          px[j][0] = pixels[idx[j] * 2];
+          px[j][1] = pixels[idx[j] * 2 + 1];
+        }
+        ok = solve_p3p4(X, px, f, cx, cy, R, t);
+        if (ok) {
+          // Polish the minimal solve on its own 4 points (uniform weights:
+          // tau huge makes every sigmoid ~1), mirroring the iterative
+          // refinement cv::solvePnP applies after P3P and the jax solver's
+          // polish_iters.
+          float X4f[12], px4f[8];
+          for (int j = 0; j < 4; j++) {
+            for (int d = 0; d < 3; d++) X4f[j * 3 + d] = static_cast<float>(X[j][d]);
+            px4f[j * 2] = static_cast<float>(px[j][0]);
+            px4f[j * 2 + 1] = static_cast<float>(px[j][1]);
+          }
+          for (int it = 0; it < 3; it++)
+            gn_step(R, t, X4f, px4f, 4, f, cx, cy, 1e6, 1.0);
+        }
+      }
+      double sc = -1.0;
+      if (ok) {
+        loc_valid++;
+        sc = score_pose(R, t, coords, pixels, n_cells, f, cx, cy, tau, beta);
+        if (sc > loc_best) {
+          loc_best = sc;
+          std::memcpy(loc_R, R, sizeof(R));
+          std::memcpy(loc_t, t, sizeof(t));
+        }
+      }
+      if (out_scores) out_scores[h] = sc;
+    }
+#ifdef _OPENMP
+#pragma omp critical
+#endif
+    {
+      n_valid += loc_valid;
+      if (loc_best > best_score) {
+        best_score = loc_best;
+        std::memcpy(best_R, loc_R, sizeof(loc_R));
+        std::memcpy(best_t, loc_t, sizeof(loc_t));
+      }
+    }
+  }
+  if (best_score < 0) return 0;
+  // Refine the winner (IRLS weighted GN, like the reference's refinement
+  // loop capped at ~100 iterations).
+  for (int it = 0; it < refine_iters; it++)
+    gn_step(best_R, best_t, coords, pixels, n_cells, f, cx, cy, tau, beta);
+  best_score =
+      score_pose(best_R, best_t, coords, pixels, n_cells, f, cx, cy, tau, beta);
+  std::memcpy(out_R, best_R, sizeof(best_R));
+  std::memcpy(out_t, best_t, sizeof(best_t));
+  *out_score = best_score;
+  return n_valid;
+}
+
+}  // extern "C"

@@ -1,0 +1,11 @@
+"""Backend dispatch: ``jax`` (TPU-native, default) and ``cpp`` (host CPU).
+
+The reference exposes one C++ torch extension; here ``--backend {cpp,jax}``
+(SURVEY.md "build target" column) selects between the XLA hypothesis kernel
+and the self-contained C++/OpenMP reference path in ``esac_cpp/``, which is
+also the measured baseline for the >=20x hypotheses/sec target.
+"""
+
+from esac_tpu.backends.cpp import cpp_available, esac_infer_cpp
+
+__all__ = ["cpp_available", "esac_infer_cpp"]

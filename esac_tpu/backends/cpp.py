@@ -1,0 +1,118 @@
+"""ctypes binding for the C++ hypothesis-loop backend.
+
+Builds ``esac_cpp/esac.cpp`` into a shared library on first use (g++ -O3
+-fopenmp; no OpenCV, no torch — the reference's build needs both, SURVEY.md
+§2 #7).  pybind11 is unavailable in this environment, so the boundary is a
+plain C ABI + ctypes, which also keeps the backend torch-free.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import subprocess
+
+import numpy as np
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+_SRC = _REPO / "esac_cpp" / "esac.cpp"
+_LIB = _REPO / "esac_cpp" / "libesac_cpp.so"
+
+_lib: ctypes.CDLL | None = None
+_build_error: str | None = None
+
+
+def _build() -> None:
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-fopenmp",
+        str(_SRC), "-o", str(_LIB),
+    ]
+    res = subprocess.run(cmd, capture_output=True, text=True)
+    if res.returncode != 0:
+        raise RuntimeError(f"esac_cpp build failed:\n{res.stderr}")
+
+
+def _load() -> ctypes.CDLL:
+    global _lib, _build_error
+    if _lib is not None:
+        return _lib
+    if _build_error is not None:
+        raise RuntimeError(_build_error)
+    try:
+        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+            _build()
+        lib = ctypes.CDLL(str(_LIB))
+    except Exception as e:  # remember the failure; don't retry every call
+        _build_error = str(e)
+        raise
+    lib.esac_cpp_infer.restype = ctypes.c_int
+    lib.esac_cpp_infer.argtypes = [
+        ctypes.POINTER(ctypes.c_float),   # coords
+        ctypes.POINTER(ctypes.c_float),   # pixels
+        ctypes.c_int,                     # n_cells
+        ctypes.c_float, ctypes.c_float, ctypes.c_float,  # f, cx, cy
+        ctypes.c_int,                     # n_hyps
+        ctypes.c_float, ctypes.c_float,   # tau, beta
+        ctypes.c_int,                     # refine_iters
+        ctypes.c_uint64,                  # seed
+        ctypes.POINTER(ctypes.c_double),  # out_R
+        ctypes.POINTER(ctypes.c_double),  # out_t
+        ctypes.POINTER(ctypes.c_double),  # out_score
+        ctypes.POINTER(ctypes.c_double),  # out_scores (may be NULL)
+    ]
+    _lib = lib
+    return lib
+
+
+def cpp_available() -> bool:
+    try:
+        _load()
+        return True
+    except Exception:
+        return False
+
+
+def esac_infer_cpp(
+    coords: np.ndarray,
+    pixels: np.ndarray,
+    f: float,
+    c: tuple[float, float],
+    n_hyps: int = 256,
+    tau: float = 10.0,
+    beta: float = 0.5,
+    refine_iters: int = 8,
+    seed: int = 0,
+    return_scores: bool = False,
+) -> dict:
+    """Single-frame hypothesis loop on the CPU backend.
+
+    coords: (N, 3) float32 scene coordinates; pixels: (N, 2) float32.
+    Returns dict with 'R' (3,3), 't' (3,), 'score', 'n_valid' (+ 'scores').
+    """
+    lib = _load()
+    coords = np.ascontiguousarray(coords, dtype=np.float32)
+    pixels = np.ascontiguousarray(pixels, dtype=np.float32)
+    n = coords.shape[0]
+    out_R = np.zeros(9, dtype=np.float64)
+    out_t = np.zeros(3, dtype=np.float64)
+    out_score = np.zeros(1, dtype=np.float64)
+    scores = np.zeros(n_hyps, dtype=np.float64) if return_scores else None
+
+    def ptr(a, ty):
+        return a.ctypes.data_as(ctypes.POINTER(ty)) if a is not None else None
+
+    n_valid = lib.esac_cpp_infer(
+        ptr(coords, ctypes.c_float), ptr(pixels, ctypes.c_float), n,
+        f, c[0], c[1], n_hyps, tau, beta, refine_iters, seed,
+        ptr(out_R, ctypes.c_double), ptr(out_t, ctypes.c_double),
+        ptr(out_score, ctypes.c_double), ptr(scores, ctypes.c_double),
+    )
+    out = {
+        "R": out_R.reshape(3, 3),
+        "t": out_t,
+        "score": float(out_score[0]),
+        "n_valid": int(n_valid),
+    }
+    if return_scores:
+        out["scores"] = scores
+    return out

@@ -1,0 +1,97 @@
+"""Shared plumbing for the entry scripts (train_expert / train_gating /
+train_esac / test_esac at the repo root).
+
+The reference's scripts are argparse CLIs over a common dataset layout
+(SURVEY.md §2 #9-12); these helpers keep the four scripts thin and their
+flag surface consistent, including the ``--backend {jax,cpp}`` switch the
+build adds (BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from esac_tpu.data.datasets import batch_frames, open_scene
+from esac_tpu.models import ExpertNet, GatingNet
+
+# Architecture presets: "ref" is the reference-scale net (SURVEY.md §2 #1),
+# "test" is sized for CPU smoke runs and CI.
+EXPERT_PRESETS = {
+    "ref": dict(stem_channels=(64, 128, 256), head_channels=512, head_depth=4),
+    "test": dict(stem_channels=(16, 32, 64), head_channels=64, head_depth=2),
+}
+GATING_PRESETS = {
+    "ref": dict(channels=(32, 64, 128, 256)),
+    "test": dict(channels=(8, 16)),
+}
+
+
+def common_parser(desc: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=desc)
+    p.add_argument("--backend", choices=("jax", "cpp"), default="jax",
+                   help="hypothesis-loop implementation (cpp = host CPU reference path)")
+    p.add_argument("--root", default="datasets", help="dataset root directory")
+    p.add_argument("--size", choices=tuple(EXPERT_PRESETS), default="ref",
+                   help="network size preset")
+    p.add_argument("--iterations", type=int, default=1000)
+    p.add_argument("--learningrate", type=float, default=1e-4)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend for the NN compute as well")
+    return p
+
+
+def maybe_force_cpu(args) -> None:
+    if getattr(args, "cpu", False):
+        jax.config.update("jax_platforms", "cpu")
+
+
+def make_expert(size: str, scene_center, dtype=None) -> ExpertNet:
+    kw = dict(EXPERT_PRESETS[size], scene_center=tuple(float(x) for x in scene_center))
+    if dtype is not None:
+        kw["compute_dtype"] = dtype
+    return ExpertNet(**kw)
+
+
+def make_gating(size: str, num_experts: int, dtype=None) -> GatingNet:
+    kw = dict(GATING_PRESETS[size], num_experts=num_experts)
+    if dtype is not None:
+        kw["compute_dtype"] = dtype
+    return GatingNet(**kw)
+
+
+def scene_center_of(ds, n_probe: int = 8) -> np.ndarray:
+    """Mean GT scene coordinate over a few frames (the per-scene offset the
+    expert regresses around, as the reference initializes with the scene
+    translation)."""
+    cs = []
+    for i in np.linspace(0, len(ds) - 1, min(n_probe, len(ds))).astype(int):
+        f = ds[int(i)]
+        if f.coords_gt is not None:
+            cs.append(f.coords_gt.reshape(-1, 3).mean(axis=0))
+    if not cs:
+        return np.zeros(3, dtype=np.float32)
+    return np.stack(cs).mean(axis=0)
+
+
+def epoch_batches(rng: np.random.Generator, n: int, batch: int):
+    """Yield random index batches forever."""
+    while True:
+        yield rng.integers(0, n, size=batch)
+
+
+__all__ = [
+    "common_parser",
+    "maybe_force_cpu",
+    "make_expert",
+    "make_gating",
+    "scene_center_of",
+    "epoch_batches",
+    "batch_frames",
+    "open_scene",
+]

@@ -1,0 +1,197 @@
+"""Dataset loaders: on-disk re-localization layouts + synthetic scenes.
+
+The reference ships per-benchmark setup scripts (7-Scenes / 12-Scenes /
+Aachen, SURVEY.md §2 #13-15) that convert each dataset into a common on-disk
+layout consumed by a torch ``Dataset``.  This module reads that common
+layout:
+
+    <root>/<scene>/{training,test}/
+        rgb/*.png                 RGB frames
+        poses/*.txt               4x4 camera-to-scene pose matrices
+        calibration/*.txt         focal length (one float per frame)
+        init/*.npy  (optional)    (h, w, 3) GT scene coordinates
+        depth/*.png (optional)    16-bit depth in mm, used to render GT
+                                  scene coordinates when init/ is absent
+
+and also provides ``SyntheticScene`` — the self-contained procedural room
+(one distinct texture per scene id) used by tests, CLI smoke runs and
+benchmarks in environments where the real datasets cannot be downloaded.
+
+Pose convention note: on-disk poses are camera-to-scene (the inverse of the
+(R, t) scene->camera transform used throughout esac_tpu.geometry); loading
+inverts them once.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from esac_tpu.data.synthetic import (
+    CAMERA_C,
+    CAMERA_F,
+    output_pixel_grid,
+    random_poses_in_box,
+    render_box_scene,
+)
+from esac_tpu.geometry.rotations import rodrigues, so3_log
+
+
+@dataclass
+class Frame:
+    image: np.ndarray        # (H, W, 3) float32 in [0, 1]
+    rvec: np.ndarray         # (3,) scene->camera
+    tvec: np.ndarray         # (3,)
+    focal: float
+    coords_gt: np.ndarray | None = None  # (h, w, 3) or None
+    expert: int = 0          # GT expert/scene label
+
+
+def _invert_pose(T: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """4x4 camera-to-scene matrix -> (rvec, tvec) scene->camera."""
+    R_cs = T[:3, :3]
+    t_cs = T[:3, 3]
+    R = R_cs.T
+    t = -R @ t_cs
+    rvec = np.asarray(so3_log(jnp.asarray(R, dtype=jnp.float32)))
+    return rvec, t.astype(np.float32)
+
+
+class SceneDataset:
+    """One scene of an on-disk dataset in the common layout."""
+
+    def __init__(self, root: str | pathlib.Path, scene: str, split: str = "training",
+                 expert: int = 0, coord_stride: int = 8):
+        self.dir = pathlib.Path(root) / scene / split
+        self.expert = expert
+        self.stride = coord_stride
+        rgb = self.dir / "rgb"
+        if not rgb.is_dir():
+            raise FileNotFoundError(f"no rgb/ under {self.dir}")
+        self.names = sorted(p.stem for p in rgb.iterdir())
+        if not self.names:
+            raise FileNotFoundError(f"empty scene {self.dir}")
+
+    def __len__(self) -> int:
+        return len(self.names)
+
+    def _find(self, sub: str, stem: str, exts: tuple[str, ...]):
+        for ext in exts:
+            p = self.dir / sub / f"{stem}{ext}"
+            if p.exists():
+                return p
+        return None
+
+    def __getitem__(self, i: int) -> Frame:
+        stem = self.names[i]
+        img_path = self._find("rgb", stem, (".png", ".jpg", ".jpeg"))
+        from PIL import Image  # deferred: pillow ships with the baked torch stack
+
+        image = np.asarray(Image.open(img_path).convert("RGB"), dtype=np.float32) / 255.0
+        T = np.loadtxt(self._find("poses", stem, (".txt",)))
+        rvec, tvec = _invert_pose(T.reshape(4, 4))
+        calib = self._find("calibration", stem, (".txt",))
+        focal = float(np.loadtxt(calib)) if calib else CAMERA_F
+
+        coords = None
+        init = self._find("init", stem, (".npy",))
+        if init is not None:
+            coords = np.load(init).astype(np.float32)
+        else:
+            depth_path = self._find("depth", stem, (".png",))
+            if depth_path is not None:
+                from PIL import Image as PImage
+
+                depth = np.asarray(PImage.open(depth_path), dtype=np.float32) / 1000.0
+                coords = self._coords_from_depth(depth, T.reshape(4, 4), focal, image.shape)
+        return Frame(image, rvec, tvec, focal, coords, self.expert)
+
+    def _coords_from_depth(self, depth, T_cs, focal, img_shape):
+        """Back-project subsampled depth through the camera-to-scene pose."""
+        H, W = img_shape[:2]
+        s = self.stride
+        d = depth[s // 2::s, s // 2::s][: H // s, : W // s]
+        grid = np.asarray(output_pixel_grid(H, W, s)).reshape(H // s, W // s, 2)
+        cx, cy = W / 2.0, H / 2.0
+        x = (grid[..., 0] - cx) / focal * d
+        y = (grid[..., 1] - cy) / focal * d
+        cam = np.stack([x, y, d], axis=-1)
+        coords = cam @ T_cs[:3, :3].T + T_cs[:3, 3]
+        # Invalid depth (0) -> NaN-free sentinel mask handled by callers via
+        # the depth==0 test.
+        coords[d == 0] = 0.0
+        return coords.astype(np.float32)
+
+
+class SyntheticScene:
+    """Procedural box-room scene ``synthN`` with per-scene texture."""
+
+    def __init__(self, scene: str = "synth0", split: str = "training",
+                 n_frames: int = 64, height: int = 96, width: int = 128,
+                 coord_stride: int = 8):
+        sid = int(scene.replace("synth", "") or 0)
+        self.sid = sid
+        self.height, self.width, self.stride = height, width, coord_stride
+        self.focal = CAMERA_F * width / 640.0
+        seed = sid * 1000 + (0 if split == "training" else 1)
+        self.rvecs, self.tvecs = random_poses_in_box(jax.random.key(seed), n_frames)
+        # Pre-render EVERYTHING once, vmapped, and keep host copies: a jitted
+        # render per __getitem__ costs a device dispatch each — through the
+        # remote-TPU tunnel of this environment that is ~100ms per frame and
+        # dominates training time.
+        render = jax.jit(
+            jax.vmap(
+                lambda rv, tv: render_box_scene(
+                    rv, tv, height, width, self.focal,
+                    (width / 2.0, height / 2.0), coord_stride,
+                    texture_phase=1.7 * sid,
+                )
+            )
+        )
+        out = render(self.rvecs, self.tvecs)
+        h, w = height // coord_stride, width // coord_stride
+        self._images = np.asarray(out["image"], dtype=np.float32)
+        self._coords = np.asarray(out["coords_gt"], dtype=np.float32).reshape(
+            n_frames, h, w, 3
+        )
+        self._rvecs = np.asarray(self.rvecs)
+        self._tvecs = np.asarray(self.tvecs)
+
+    def __len__(self) -> int:
+        return self._images.shape[0]
+
+    def __getitem__(self, i: int) -> Frame:
+        return Frame(
+            self._images[i],
+            self._rvecs[i],
+            self._tvecs[i],
+            self.focal,
+            self._coords[i],
+            self.sid,
+        )
+
+
+def open_scene(root: str, scene: str, split: str, expert: int = 0, **kw):
+    """Dispatch: ``synthN`` -> SyntheticScene, else on-disk SceneDataset."""
+    if scene.startswith("synth"):
+        return SyntheticScene(scene, split, **kw)
+    return SceneDataset(root, scene, split, expert=expert)
+
+
+def batch_frames(ds, idx: np.ndarray) -> dict:
+    """Stack frames into jnp arrays for a training step."""
+    frames = [ds[int(i)] for i in idx]
+    out = {
+        "images": jnp.stack([jnp.asarray(f.image) for f in frames]),
+        "rvecs": jnp.stack([jnp.asarray(f.rvec) for f in frames]),
+        "tvecs": jnp.stack([jnp.asarray(f.tvec) for f in frames]),
+        "labels": jnp.asarray([f.expert for f in frames]),
+        "focal": frames[0].focal,
+    }
+    if frames[0].coords_gt is not None:
+        out["coords_gt"] = jnp.stack([jnp.asarray(f.coords_gt) for f in frames])
+    return out

@@ -75,17 +75,21 @@ def _ray_box_depth(origin: jnp.ndarray, dirs: jnp.ndarray) -> jnp.ndarray:
     return jnp.min(s_exit, axis=-1)
 
 
-def _wall_texture(X: jnp.ndarray) -> jnp.ndarray:
+def _wall_texture(X: jnp.ndarray, texture_phase: float = 0.0) -> jnp.ndarray:
     """Procedural RGB texture of a scene point (N, 3) -> (N, 3) in [0, 1].
 
     Smooth, position-unique multi-frequency pattern: gives the expert enough
-    visual signal to invert position from appearance.
+    visual signal to invert position from appearance.  ``texture_phase``
+    shifts the pattern so different synthetic "scenes" look different (each
+    ESAC expert owns one scene; the gating net must tell them apart).
     """
     freqs = jnp.array([1.3, 2.9, 0.7])
-    phases = jnp.array([0.0, 1.1, 2.3])
+    phases = jnp.array([0.0, 1.1, 2.3]) + texture_phase
     r = 0.5 + 0.5 * jnp.sin(X @ jnp.array([1.7, 0.9, 2.3]) * freqs[0] + phases[0])
     g = 0.5 + 0.5 * jnp.sin(X @ jnp.array([0.6, 2.2, 1.1]) * freqs[1] + phases[1])
-    b = 0.5 + 0.5 * jnp.sin(X @ jnp.array([2.9, 1.4, 0.5]) * freqs[2] + phases[2])
+    b = 0.5 + 0.5 * jnp.sin(
+        X @ jnp.array([2.9, 1.4, 0.5]) * (freqs[2] + 0.13 * texture_phase) + phases[2]
+    )
     return jnp.stack([r, g, b], axis=-1)
 
 
@@ -97,6 +101,7 @@ def render_box_scene(
     f: float = CAMERA_F,
     c: tuple[float, float] = CAMERA_C,
     coord_stride: int = 8,
+    texture_phase: float = 0.0,
 ) -> dict:
     """Render one frame of the box room.
 
@@ -123,7 +128,7 @@ def render_box_scene(
     xs = jnp.arange(width) + 0.5
     gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
     px_full = jnp.stack([gx, gy], axis=-1).reshape(-1, 2)
-    img = _wall_texture(scene_points(px_full)).reshape(height, width, 3)
+    img = _wall_texture(scene_points(px_full), texture_phase).reshape(height, width, 3)
 
     # Subsampled ground-truth coordinate map.
     pixels = output_pixel_grid(height, width, coord_stride)

@@ -13,7 +13,7 @@ The TPU-native design here is different end to end:
     branchless complex Ferrari solver in ``quartic.py`` since XLA-on-TPU has
     no nonsymmetric eig), all four root branches evaluated in parallel and
     disambiguated by the 4th point's reprojection error, pose recovered per
-    branch with a differentiable Kabsch/Procrustes SVD, then polished with a
+    branch with a differentiable orthonormal-triad alignment, then polished with a
     few Gauss-Newton steps on reprojection error.
 2.  **Refinement (N points, soft weights)** — weighted Gauss-Newton on the
     6-DoF axis-angle pose; fixed iteration counts, LM damping.  Because every
@@ -34,7 +34,7 @@ import jax.numpy as jnp
 from esac_tpu.geometry.camera import MIN_DEPTH, reprojection_errors
 from esac_tpu.geometry.quartic import solve_quartic
 from esac_tpu.geometry.rotations import rodrigues, so3_log
-from esac_tpu.utils.num import safe_sqrt
+from esac_tpu.utils.num import safe_norm, safe_sqrt
 from esac_tpu.utils.precision import hmm
 
 # Pair indices of the 6 unordered pairs of 4 points.
@@ -119,38 +119,128 @@ def _p3p_depths(b3: jnp.ndarray, X3: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndar
     return depths, penalty
 
 
-def _kabsch(X: jnp.ndarray, Y: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Rigid pose (R, t) with Y ~= R X + t, by Procrustes SVD. X, Y: (N, 3)."""
-    Xm = X.mean(axis=0)
-    Ym = Y.mean(axis=0)
-    H = hmm((X - Xm).T, Y - Ym)
-    # Distinct-diagonal jitter: the SVD VJP has 1/(s_i^2 - s_j^2) factors, so
-    # repeated singular values (e.g. H = 0 for a degenerate sample) give NaN
-    # gradients.  1e-6 is ~1e-6 of a typical H entry (meter-scale spreads);
-    # the GN polish removes any forward bias.
-    H = H + jnp.diag(jnp.array([1e-6, 2e-6, 3e-6], dtype=H.dtype))
-    U, _, Vt = jnp.linalg.svd(H)
-    # Proper rotation: flip the last singular direction if det < 0.
-    det = jnp.linalg.det(hmm(Vt.T, U.T))
-    S = jnp.diag(jnp.array([1.0, 1.0, 1.0], dtype=X.dtype)).at[2, 2].set(det)
-    R = hmm(hmm(Vt.T, S), U.T)
-    t = Ym - hmm(R, Xm[:, None])[:, 0]
+def _triad_align(X: jnp.ndarray, Y: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Rigid pose (R, t) with Y ~= R X + t from exactly 3 correspondences.
+
+    Orthonormal-triad method: build a frame from the two difference vectors
+    in each point set, R maps one basis to the other.  Exact for the exact
+    correspondences P3P produces, and — unlike Procrustes/SVD — made of pure
+    elementwise arithmetic, which matters: batched 3x3 SVDs lower to scalar
+    loops on TPU and dominated the minimal-solve profile.  Degenerate
+    (collinear) triples produce a finite garbage pose via the safe_norm
+    guards; downstream penalties reject it.
+    """
+    ux, vx = X[1] - X[0], X[2] - X[0]
+    uy, vy = Y[1] - Y[0], Y[2] - Y[0]
+    nx = jnp.cross(ux, vx)
+    ny = jnp.cross(uy, vy)
+    e1x = ux / safe_norm(ux)
+    e3x = nx / safe_norm(nx)
+    e2x = jnp.cross(e3x, e1x)
+    e1y = uy / safe_norm(uy)
+    e3y = ny / safe_norm(ny)
+    e2y = jnp.cross(e3y, e1y)
+    Bx = jnp.stack([e1x, e2x, e3x], axis=-1)  # columns
+    By = jnp.stack([e1y, e2y, e3y], axis=-1)
+    R = hmm(By, Bx.T)
+    t = Y.mean(axis=0) - hmm(R, X.mean(axis=0)[:, None])[:, 0]
     return R, t
 
 
-def _pose_residuals(
-    p: jnp.ndarray,
+def _solve6_spd(A: jnp.ndarray, g: jnp.ndarray) -> jnp.ndarray:
+    """Solve the damped SPD 6x6 normal equations by unrolled Gauss-Jordan.
+
+    ``jnp.linalg.solve`` lowers to a pivoting LU with scalar loops on TPU —
+    catastrophic when vmapped over thousands of hypotheses.  Six unrolled
+    elimination steps are pure vectorized arithmetic.  No pivoting needed:
+    A is SPD + Levenberg damping, so diagonals stay positive.
+    """
+    M = jnp.concatenate([A, g[:, None]], axis=1)  # (6, 7)
+    for i in range(6):
+        piv = M[i, i]
+        piv = jnp.where(jnp.abs(piv) < 1e-12, 1e-12, piv)
+        row = M[i] / piv
+        factors = M[:, i].at[i].set(0.0)
+        M = M - factors[:, None] * row[None, :]
+        M = M.at[i].set(row)
+    return M[:, 6]
+
+
+def _gn_pose_step(
+    R: jnp.ndarray,
+    t: jnp.ndarray,
     X: jnp.ndarray,
     x2d: jnp.ndarray,
     f: jnp.ndarray,
     c: jnp.ndarray,
-) -> jnp.ndarray:
-    """Flattened weighted-less reprojection residuals for a 6-vector pose."""
-    R = rodrigues(p[:3])
-    Y = hmm(X, R.T) + p[3:]
-    z = jnp.maximum(Y[:, 2:3], MIN_DEPTH)
-    xp = Y[:, :2] / z * f + c
-    return (xp - x2d).reshape(-1)
+    w: jnp.ndarray,
+    damping: float,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One weighted GN/LM step with a hand-derived Jacobian.
+
+    Left-multiplicative rotation update (R <- exp(delta) R): the Jacobian of
+    the projected point wrt the rotation perturbation is built from
+    d(exp(d) W)/dd = -skew(W) with W = R X, all elementwise — no jacfwd
+    re-tracing of Rodrigues, which dominated the original profile.
+    """
+    Y = hmm(X, R.T) + t  # (N, 3)
+    z = jnp.maximum(Y[:, 2], MIN_DEPTH)
+    inv_z = 1.0 / z
+    u = f * Y[:, 0] * inv_z + c[0]
+    v = f * Y[:, 1] * inv_z + c[1]
+    ru = u - x2d[:, 0]
+    rv = v - x2d[:, 1]
+    # du/dY = f * [1/z, 0, -Y0/z^2]; dv/dY = f * [0, 1/z, -Y1/z^2].
+    # Where the depth clamp is active (point at/behind the camera plane) the
+    # residual is constant in Y2, so its z-derivative must be zero — autodiff
+    # through jnp.maximum gave exactly that, and the hand-derived Jacobian
+    # must match or GN chases a phantom gradient on clamped points.
+    clamped = Y[:, 2] < MIN_DEPTH
+    fu0 = f * inv_z
+    fu2 = jnp.where(clamped, 0.0, -f * Y[:, 0] * inv_z * inv_z)
+    fv2 = jnp.where(clamped, 0.0, -f * Y[:, 1] * inv_z * inv_z)
+    W = Y - t  # = R X
+    # d(exp(d) W)/dd_k = e_k x W:
+    # e0 x W = (0, -W2, W1);  e1 x W = (W2, 0, -W0);  e2 x W = (-W1, W0, 0)
+    ju_d0 = fu2 * W[:, 1]
+    ju_d1 = fu0 * W[:, 2] - fu2 * W[:, 0]
+    ju_d2 = -fu0 * W[:, 1]
+    jv_d0 = -fu0 * W[:, 2] + fv2 * W[:, 1]
+    jv_d1 = -fv2 * W[:, 0]
+    jv_d2 = fu0 * W[:, 0]
+    rowu = jnp.stack([ju_d0, ju_d1, ju_d2, fu0, jnp.zeros_like(fu0), fu2], axis=-1)
+    rowv = jnp.stack([jv_d0, jv_d1, jv_d2, jnp.zeros_like(fu0), fu0, fv2], axis=-1)
+    wu = w[:, None] * rowu
+    wv = w[:, None] * rowv
+    A = hmm(rowu.T, wu) + hmm(rowv.T, wv)  # (6, 6)
+    g = hmm(wu.T, ru[:, None])[:, 0] + hmm(wv.T, rv[:, None])[:, 0]
+    mu = damping * (jnp.trace(A) / 6.0 + 1e-6)
+    delta = _solve6_spd(A + mu * jnp.eye(6, dtype=A.dtype), g)
+    R_new = hmm(rodrigues(-delta[:3]), R)
+    t_new = t - delta[3:]
+    return R_new, t_new
+
+
+def refine_pose_gn_R(
+    R: jnp.ndarray,
+    tvec: jnp.ndarray,
+    X: jnp.ndarray,
+    x2d: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    weights: jnp.ndarray | None = None,
+    iters: int = 5,
+    damping: float = 1e-4,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """R-in/R-out weighted GN — the hot-path entry, no axis-angle round-trips."""
+    w = jnp.ones(X.shape[0], dtype=X.dtype) if weights is None else weights
+
+    def step(carry, _):
+        Ri, ti = carry
+        return _gn_pose_step(Ri, ti, X, x2d, f, c, w, damping), None
+
+    (R, t), _ = jax.lax.scan(step, (R, tvec), None, length=iters)
+    return R, t
 
 
 @partial(jax.jit, static_argnames=("iters",))
@@ -170,25 +260,12 @@ def refine_pose_gn(
     Replaces the reference's iterative cv::solvePnP refinement loop
     (SURVEY.md §3.5 "refine winner") with a differentiable, fixed-length LM.
     ``weights`` is (N,) per-point (soft-inlier) weights; None = uniform.
+    Axis-angle boundary; inside the vmapped kernel use ``refine_pose_gn_R``.
     """
-    p0 = jnp.concatenate([rvec, tvec])
-    w = jnp.ones(X.shape[0], dtype=X.dtype) if weights is None else weights
-    # Each point contributes two residuals (u, v).
-    w2 = jnp.repeat(w, 2)
-    jac = jax.jacfwd(_pose_residuals)
-
-    def step(p, _):
-        r = _pose_residuals(p, X, x2d, f, c)
-        J = jac(p, X, x2d, f, c)  # (2N, 6)
-        Jw = J * w2[:, None]
-        A = hmm(J.T, Jw)
-        mu = damping * (jnp.trace(A) / 6.0 + 1e-6)
-        g = hmm(Jw.T, r[:, None])[:, 0]
-        delta = jnp.linalg.solve(A + mu * jnp.eye(6, dtype=A.dtype), g)
-        return p - delta, None
-
-    p, _ = jax.lax.scan(step, p0, None, length=iters)
-    return p[:3], p[3:]
+    R, t = refine_pose_gn_R(
+        rodrigues(rvec), tvec, X, x2d, f, c, weights, iters, damping
+    )
+    return so3_log(R), t
 
 
 @partial(jax.jit, static_argnames=("polish_iters",))
@@ -211,7 +288,7 @@ def solve_pnp_minimal(
 
     def candidate(lam3):
         Y3 = lam3[:, None] * b[:3]
-        R, t = _kabsch(X4[:3], Y3)
+        R, t = _triad_align(X4[:3], Y3)
         # Disambiguate with the 4th correspondence.
         err4 = reprojection_errors(R, t, X4[3:4], x4[3:4], f, c)[0]
         return R, t, err4
@@ -220,12 +297,10 @@ def solve_pnp_minimal(
     # A NaN branch (pathological geometry) must never win the argmin.
     cost = err4s + penalty
     best = jnp.argmin(jnp.where(jnp.isnan(cost), jnp.inf, cost))
-    rvec = so3_log(Rs[best])
-    t = ts[best]
-    rvec, t = refine_pose_gn(
-        rvec, t, X4, x4, f, c, weights=None, iters=polish_iters
+    R, t = refine_pose_gn_R(
+        Rs[best], ts[best], X4, x4, f, c, weights=None, iters=polish_iters
     )
-    return rvec, t
+    return so3_log(R), t
 
 
 def pnp_success(

@@ -18,8 +18,8 @@ import jax
 import jax.numpy as jnp
 
 from esac_tpu.geometry.camera import reprojection_errors
-from esac_tpu.geometry.pnp import refine_pose_gn
-from esac_tpu.geometry.rotations import rodrigues
+from esac_tpu.geometry.pnp import refine_pose_gn_R
+from esac_tpu.geometry.rotations import rodrigues, so3_log
 from esac_tpu.ransac.scoring import soft_inlier_weights
 
 
@@ -45,16 +45,19 @@ def refine_soft_inliers(
     through the weighted residuals.
     """
 
+    # Carry the rotation MATRIX through the IRLS scan: converting to/from
+    # axis-angle every iteration would run so3_log's branchy near-pi path
+    # inside the vmapped hot loop for nothing.
     def body(carry, _):
-        rv, tv = carry
-        errs = reprojection_errors(rodrigues(rv), tv, coords, pixels, f, c)
+        R, tv = carry
+        errs = reprojection_errors(R, tv, coords, pixels, f, c)
         w = soft_inlier_weights(errs, tau, beta)
         if stop_weight_grad:
             w = jax.lax.stop_gradient(w)
-        rv, tv = refine_pose_gn(
-            rv, tv, coords, pixels, f, c, weights=w, iters=gn_steps_per_iter
+        R, tv = refine_pose_gn_R(
+            R, tv, coords, pixels, f, c, weights=w, iters=gn_steps_per_iter
         )
-        return (rv, tv), None
+        return (R, tv), None
 
-    (rvec, tvec), _ = jax.lax.scan(body, (rvec, tvec), None, length=iters)
-    return rvec, tvec
+    (R, tvec), _ = jax.lax.scan(body, (rodrigues(rvec), tvec), None, length=iters)
+    return so3_log(R), tvec

@@ -1,0 +1,36 @@
+"""Checkpoint save/restore: Orbax param trees + JSON config sidecar.
+
+Reference counterpart: periodic ``torch.save(state_dict)`` (SURVEY.md §5
+"Checkpoint / resume").  Here a checkpoint is a directory:
+
+    <path>/params/   Orbax PyTree checkpoint (params, optionally opt state)
+    <path>/config.json   net architecture + scene metadata
+
+so any entry script can reconstruct the exact module without re-specifying
+flags, and torch checkpoints can be converted in via
+``esac_tpu.models.convert`` then saved through this module.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Any
+
+import orbax.checkpoint as ocp
+
+
+def save_checkpoint(path: str | pathlib.Path, params: Any, config: dict) -> None:
+    path = pathlib.Path(path).absolute()
+    path.mkdir(parents=True, exist_ok=True)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path / "params", params, force=True)
+    (path / "config.json").write_text(json.dumps(config, indent=2))
+
+
+def load_checkpoint(path: str | pathlib.Path) -> tuple[Any, dict]:
+    path = pathlib.Path(path).absolute()
+    with ocp.PyTreeCheckpointer() as ckptr:
+        params = ckptr.restore(path / "params")
+    config = json.loads((path / "config.json").read_text())
+    return params, config

@@ -1,0 +1,129 @@
+#!/usr/bin/env python3
+"""Evaluate ESAC: median pose errors, % within 5cm/5deg, per-frame timing.
+
+Reference counterpart: ``test_esac.py`` (SURVEY.md §2 #12, §3.4).
+
+    python test_esac.py synth0 synth1 --size test \
+        --experts ckpt_expert_synth0 ckpt_expert_synth1 --gating ckpt_gating
+    ... --backend cpp    # run the hypothesis loop on the C++ host path
+
+With ``--backend cpp`` the networks still run under JAX (the reference runs
+its CNNs on GPU regardless of the extension); only the hypothesis loop
+(sample/solve/score/select/refine) switches to the C++ backend.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from esac_tpu.cli import (
+    common_parser, make_expert, make_gating, maybe_force_cpu, open_scene,
+)
+from esac_tpu.data.synthetic import output_pixel_grid
+from esac_tpu.geometry import pose_errors, rodrigues
+from esac_tpu.ransac import RansacConfig, esac_infer
+from esac_tpu.utils.checkpoint import load_checkpoint
+
+
+def main(argv=None) -> int:
+    p = common_parser(__doc__)
+    p.add_argument("scenes", nargs="+")
+    p.add_argument("--experts", nargs="+", required=True)
+    p.add_argument("--gating", required=True)
+    p.add_argument("--hypotheses", type=int, default=256)
+    p.add_argument("--limit", type=int, default=0, help="max frames per scene (0 = all)")
+    args = p.parse_args(argv)
+    maybe_force_cpu(args)
+
+    datasets = [
+        open_scene(args.root, s, "test", expert=i) for i, s in enumerate(args.scenes)
+    ]
+    M = len(datasets)
+    e_params, e_nets = [], []
+    for ck in args.experts:
+        params, cfg_d = load_checkpoint(ck)
+        e_params.append(params)
+        e_nets.append(make_expert(cfg_d["size"], cfg_d["scene_center"]))
+    g_params, g_cfg = load_checkpoint(args.gating)
+    gating = make_gating(g_cfg["size"], M)
+
+    f0 = datasets[0][0]
+    H, W = f0.image.shape[:2]
+    pixels = output_pixel_grid(H, W, 8)
+    cx = jnp.asarray([W / 2.0, H / 2.0])
+    cfg = RansacConfig(n_hyps=args.hypotheses)
+
+    @jax.jit
+    def predict_coords(image):
+        logits = gating.apply(g_params, image[None])[0]
+        coords = jnp.stack(
+            [e_nets[m].apply(e_params[m], image[None])[0] for m in range(M)]
+        )
+        return logits, coords.reshape(M, -1, 3)
+
+    infer_jax = jax.jit(
+        lambda k, lg, ca, focal: esac_infer(k, lg, ca, pixels, focal, cx, cfg)
+    )
+
+    rot_errs, trans_errs, times, ok, expert_ok = [], [], [], 0, 0
+    n_total = 0
+    for ds in datasets:
+        n = len(ds) if args.limit == 0 else min(args.limit, len(ds))
+        for i in range(n):
+            fr = ds[i]
+            image = jnp.asarray(fr.image)
+            focal = jnp.float32(fr.focal)
+            logits, coords_all = predict_coords(image)
+            jax.block_until_ready(coords_all)
+            t0 = time.perf_counter()
+            if args.backend == "jax":
+                out = infer_jax(jax.random.key(n_total), logits, coords_all, focal)
+                rvec, tvec = out["rvec"], out["tvec"]
+                jax.block_until_ready(rvec)
+                expert = int(out["expert"])
+                R_est = rodrigues(rvec)
+            else:
+                from esac_tpu.backends import esac_infer_cpp
+
+                best = None
+                for m in range(M):
+                    r = esac_infer_cpp(
+                        np.asarray(coords_all[m]), np.asarray(pixels),
+                        float(focal), (W / 2.0, H / 2.0),
+                        n_hyps=args.hypotheses, seed=n_total * M + m,
+                    )
+                    if best is None or r["score"] > best[0]["score"]:
+                        best = (r, m)
+                expert = best[1]
+                R_est = jnp.asarray(best[0]["R"], jnp.float32)
+                tvec = jnp.asarray(best[0]["t"], jnp.float32)
+            times.append(time.perf_counter() - t0)
+            r_err, t_err = pose_errors(
+                R_est, tvec, rodrigues(jnp.asarray(fr.rvec)), jnp.asarray(fr.tvec)
+            )
+            rot_errs.append(float(r_err))
+            trans_errs.append(float(t_err))
+            ok += bool(r_err < 5.0 and t_err < 0.05)
+            expert_ok += expert == fr.expert
+            n_total += 1
+
+    rot = np.asarray(rot_errs)
+    tr = np.asarray(trans_errs)
+    tm = np.asarray(times[1:]) if len(times) > 1 else np.asarray(times)
+    print(f"frames: {n_total}")
+    print(f"median rot err:   {np.median(rot):.2f} deg")
+    print(f"median trans err: {100 * np.median(tr):.2f} cm")
+    print(f"5cm/5deg:         {100.0 * ok / n_total:.1f}%")
+    print(f"expert accuracy:  {100.0 * expert_ok / n_total:.1f}%")
+    print(f"median time:      {1e3 * np.median(tm):.1f} ms/frame "
+          f"({args.hypotheses * M} hyps, backend={args.backend})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,87 @@
+"""Backend equivalence: cpp vs jax (SURVEY.md §4, the decisive test class).
+
+RNG streams cannot be bit-identical across backends (different generators by
+design; the sampling contract in esac_tpu/ransac/sampling.py documents
+this), so equivalence is statistical: same inputs -> both backends localize
+within tolerance of GT and of each other, and score the same pose equally.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esac_tpu.backends import cpp_available, esac_infer_cpp
+from esac_tpu.data import CAMERA_F, make_correspondence_frame
+from esac_tpu.geometry import pose_errors, rodrigues
+from esac_tpu.ransac import RansacConfig, dsac_infer
+from esac_tpu.ransac.scoring import reprojection_error_map, soft_inlier_score
+
+pytestmark = pytest.mark.skipif(not cpp_available(), reason="cpp backend unavailable")
+
+F = 525.0
+C = (320.0, 240.0)
+
+
+@pytest.mark.parametrize("seed", [1, 2])
+def test_backends_agree_on_pose(seed):
+    frame = make_correspondence_frame(
+        jax.random.key(seed), noise=0.01, outlier_frac=0.3
+    )
+    co, px = np.asarray(frame["coords"]), np.asarray(frame["pixels"])
+    cpp = esac_infer_cpp(co, px, F, C, n_hyps=256, seed=seed)
+    jout = dsac_infer(
+        jax.random.key(seed), frame["coords"], frame["pixels"],
+        jnp.float32(F), jnp.asarray(C), RansacConfig(n_hyps=256),
+    )
+    R_gt, t_gt = rodrigues(frame["rvec"]), frame["tvec"]
+    r_c, t_c = pose_errors(jnp.asarray(cpp["R"], jnp.float32), jnp.asarray(cpp["t"], jnp.float32), R_gt, t_gt)
+    r_j, t_j = pose_errors(rodrigues(jout["rvec"]), jout["tvec"], R_gt, t_gt)
+    assert r_c < 1.0 and t_c < 0.02, f"cpp off: {r_c} deg {t_c} m"
+    assert r_j < 1.0 and t_j < 0.02, f"jax off: {r_j} deg {t_j} m"
+    # Backends agree with each other (both anchored to GT).
+    r_x, t_x = pose_errors(
+        jnp.asarray(cpp["R"], jnp.float32), jnp.asarray(cpp["t"], jnp.float32),
+        rodrigues(jout["rvec"]), jout["tvec"],
+    )
+    assert r_x < 1.5 and t_x < 0.03
+
+
+def test_scoring_functions_match():
+    """The jax soft-inlier score of the cpp winner must match cpp's own score."""
+    frame = make_correspondence_frame(jax.random.key(3), noise=0.02, outlier_frac=0.2)
+    co, px = np.asarray(frame["coords"]), np.asarray(frame["pixels"])
+    cpp = esac_infer_cpp(co, px, F, C, n_hyps=128, seed=3)
+    from esac_tpu.geometry.rotations import so3_log
+
+    rvec = so3_log(jnp.asarray(cpp["R"], jnp.float32))
+    errors = reprojection_error_map(
+        rvec[None], jnp.asarray(cpp["t"], jnp.float32)[None],
+        frame["coords"], frame["pixels"], jnp.float32(F), jnp.asarray(C),
+    )
+    jax_score = float(soft_inlier_score(errors, 10.0, 0.5)[0])
+    assert jax_score == pytest.approx(cpp["score"], rel=0.01)
+
+
+def test_cpp_score_distribution_sane():
+    """Score curves statistically matched: both backends' hypothesis pools
+    should contain high-inlier hypotheses at similar rates."""
+    frame = make_correspondence_frame(jax.random.key(4), noise=0.01)
+    co, px = np.asarray(frame["coords"]), np.asarray(frame["pixels"])
+    n_cells = co.shape[0]
+    cpp = esac_infer_cpp(co, px, F, C, n_hyps=256, seed=4, return_scores=True)
+    cpp_frac = (cpp["scores"] > 0.5 * n_cells).mean()
+
+    from esac_tpu.ransac.kernel import generate_hypotheses
+
+    cfg = RansacConfig(n_hyps=256)
+    rv, tv = generate_hypotheses(
+        jax.random.key(4), frame["coords"], frame["pixels"],
+        jnp.float32(F), jnp.asarray(C), cfg,
+    )
+    errors = reprojection_error_map(
+        rv, tv, frame["coords"], frame["pixels"], jnp.float32(F), jnp.asarray(C)
+    )
+    jax_frac = float((soft_inlier_score(errors, cfg.tau, cfg.beta) > 0.5 * n_cells).mean())
+    assert cpp_frac > 0.3 and jax_frac > 0.3
+    assert abs(cpp_frac - jax_frac) < 0.25, (cpp_frac, jax_frac)

@@ -23,11 +23,16 @@ SMALL_C = jnp.array([80.0, 60.0])
 CFG = RansacConfig(n_hyps=64, refine_iters=4, train_refine_iters=1)
 
 
-def test_sampling_distinct_and_reproducible():
+def test_sampling_reproducible_and_well_spread():
     idx = sample_correspondence_sets(jax.random.key(0), 128, 300)
     assert idx.shape == (128, 4)
-    for row in np.asarray(idx):
-        assert len(set(row.tolist())) == 4
+    assert int(idx.min()) >= 0 and int(idx.max()) < 300
+    # Fast sampler tolerates rare collisions (see sampling.py); the collision
+    # rate must stay near the theoretical ~6/n_cells.
+    col = sum(
+        1 for row in np.asarray(idx) if len(set(row.tolist())) < 4
+    ) / idx.shape[0]
+    assert col < 0.1
     idx2 = sample_correspondence_sets(jax.random.key(0), 128, 300)
     np.testing.assert_array_equal(idx, idx2)
     idx3 = sample_correspondence_sets(jax.random.key(1), 128, 300)
@@ -35,6 +40,14 @@ def test_sampling_distinct_and_reproducible():
     # Coverage: with 512 draws of 4 from 300 cells, most cells get sampled.
     counts = np.bincount(np.asarray(idx).ravel(), minlength=300)
     assert (counts > 0).mean() > 0.7
+
+
+def test_sampling_exact_variant_distinct():
+    from esac_tpu.ransac.sampling import sample_correspondence_sets_exact
+
+    idx = sample_correspondence_sets_exact(jax.random.key(0), 64, 300)
+    for row in np.asarray(idx):
+        assert len(set(row.tolist())) == 4
 
 
 @pytest.mark.parametrize("outlier_frac", [0.0, 0.3])

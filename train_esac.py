@@ -1,0 +1,131 @@
+#!/usr/bin/env python3
+"""Stage 3: end-to-end ESAC training through the hypothesis kernel.
+
+Reference counterpart: ``train_esac.py`` (SURVEY.md §2 #11, §3.3): loads the
+stage-1 expert checkpoints and the stage-2 gating checkpoint, then minimizes
+the expected pose loss through sampling/PnP/scoring/selection/refinement.
+
+    python train_esac.py synth0 synth1 --size test --iterations 50 \
+        --experts ckpt_expert_synth0 ckpt_expert_synth1 --gating ckpt_gating
+
+``--estimator dense`` (default) is the exact-gating-gradient TPU path;
+``--estimator sampled`` is the reference-parity REINFORCE estimator.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from esac_tpu.cli import (
+    batch_frames, common_parser, make_expert, make_gating, maybe_force_cpu,
+    open_scene,
+)
+from esac_tpu.data.synthetic import output_pixel_grid
+from esac_tpu.geometry import rodrigues
+from esac_tpu.ransac import RansacConfig, esac_train_loss
+from esac_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+
+def main(argv=None) -> int:
+    p = common_parser(__doc__)
+    p.add_argument("scenes", nargs="+")
+    p.add_argument("--experts", nargs="+", required=True,
+                   help="stage-1 expert checkpoint dirs, one per scene")
+    p.add_argument("--gating", required=True, help="stage-2 gating checkpoint")
+    p.add_argument("--hypotheses", type=int, default=256)
+    p.add_argument("--estimator", choices=("dense", "sampled"), default="dense")
+    p.add_argument("--output", default="ckpt_esac")
+    args = p.parse_args(argv)
+    maybe_force_cpu(args)
+    if len(args.experts) != len(args.scenes):
+        p.error("need one --experts checkpoint per scene")
+
+    datasets = [
+        open_scene(args.root, s, "training", expert=i)
+        for i, s in enumerate(args.scenes)
+    ]
+    M = len(datasets)
+
+    e_params, e_nets = [], []
+    for ck in args.experts:
+        params, cfg_d = load_checkpoint(ck)
+        e_params.append(params)
+        e_nets.append(make_expert(cfg_d["size"], cfg_d["scene_center"]))
+    g_params, g_cfg = load_checkpoint(args.gating)
+    gating = make_gating(g_cfg["size"], M)
+
+    f0 = datasets[0][0]
+    H, W = f0.image.shape[:2]
+    stride = 8
+    pixels = output_pixel_grid(H, W, stride)
+    cfg = RansacConfig(n_hyps=args.hypotheses, train_refine_iters=1)
+    cx = jnp.asarray([W / 2.0, H / 2.0])
+
+    opt = optax.adam(args.learningrate)
+    opt_state = opt.init((e_params, g_params))
+
+    @jax.jit
+    def train_step(params, opt_state, key, images, R_gts, t_gts, focal):
+        def loss_fn(ps):
+            e_ps, g_p = ps
+            logits = gating.apply(g_p, images)  # (B, M)
+            coords = jnp.stack(
+                [e_nets[m].apply(e_ps[m], images) for m in range(M)], axis=1
+            )  # (B, M, h, w, 3)
+            B = images.shape[0]
+            coords = coords.reshape(B, M, -1, 3)
+            keys = jax.random.split(key, B)
+            losses, _ = jax.vmap(
+                lambda k, lg, ca, Rg, tg: esac_train_loss(
+                    k, lg, ca, pixels, focal, cx, Rg, tg, cfg, args.estimator
+                )
+            )(keys, logits, coords, R_gts, t_gts)
+            return jnp.mean(losses)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    # Stage all scenes on device once (see train_expert.py).
+    staged = [batch_frames(d, np.arange(len(d))) for d in datasets]
+    images_d = jnp.concatenate([b["images"] for b in staged])
+    rvecs_d = jnp.concatenate([b["rvecs"] for b in staged])
+    tvecs_d = jnp.concatenate([b["tvecs"] for b in staged])
+    R_gts_d = jax.vmap(rodrigues)(rvecs_d)
+    focal = jnp.float32(staged[0]["focal"])
+
+    rng = np.random.default_rng(args.seed)
+    params = (e_params, g_params)
+    t0 = time.time()
+    loss = float("nan")
+    for it in range(args.iterations):
+        idx = jnp.asarray(rng.integers(0, images_d.shape[0], size=args.batch))
+        params, opt_state, loss = train_step(
+            params, opt_state, jax.random.key(args.seed * 7919 + it),
+            images_d[idx], R_gts_d[idx], tvecs_d[idx], focal,
+        )
+        if it % max(1, args.iterations // 20) == 0:
+            print(f"iter {it:6d}  E[pose loss] {float(loss):.3f}  "
+                  f"({time.time() - t0:.0f}s)", flush=True)
+
+    e_params, g_params = params
+    for m, ck in enumerate(args.experts):
+        _, cfg_d = load_checkpoint(ck)
+        cfg_d["e2e"] = True
+        save_checkpoint(f"{args.output}_expert{m}", e_params[m], cfg_d)
+    g_cfg["e2e"] = True
+    save_checkpoint(f"{args.output}_gating", g_params, g_cfg)
+    print(f"saved {args.output}_expert*/{args.output}_gating  "
+          f"final E[pose loss] {float(loss):.3f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

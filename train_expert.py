@@ -1,0 +1,88 @@
+#!/usr/bin/env python3
+"""Stage 1: train one expert's scene-coordinate regression network.
+
+Reference counterpart: ``train_expert.py`` (SURVEY.md §2 #9, §3.1) — run once
+per scene/expert.  Example:
+
+    python train_expert.py chess --root datasets/7scenes --iterations 300000
+    python train_expert.py synth0 --size test --iterations 500   # synthetic
+
+Writes a checkpoint directory (--output, default ``ckpt_expert_<scene>``).
+The ``--backend`` flag exists for surface parity; stage-1 involves no
+hypothesis loop, so both backends train identically through JAX.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from esac_tpu.cli import (
+    batch_frames, common_parser, epoch_batches, make_expert, maybe_force_cpu,
+    open_scene, scene_center_of,
+)
+from esac_tpu.train import make_expert_train_step
+from esac_tpu.utils.checkpoint import save_checkpoint
+
+
+def main(argv=None) -> int:
+    p = common_parser(__doc__)
+    p.add_argument("scene", help="scene name (or synthN for the synthetic room)")
+    p.add_argument("--output", default=None, help="checkpoint directory")
+    args = p.parse_args(argv)
+    maybe_force_cpu(args)
+
+    ds = open_scene(args.root, args.scene, "training")
+    center = scene_center_of(ds)
+    net = make_expert(args.size, center)
+
+    probe = batch_frames(ds, np.array([0]))
+    params = net.init(jax.random.key(args.seed), probe["images"])
+    n_params = sum(p_.size for p_ in jax.tree.leaves(params))
+    print(f"scene={args.scene} frames={len(ds)} params={n_params/1e6:.2f}M "
+          f"center={np.round(center, 2).tolist()}")
+
+    opt = optax.adam(optax.cosine_decay_schedule(args.learningrate, args.iterations, 0.05))
+    opt_state = opt.init(params)
+    step = make_expert_train_step(net, opt)
+
+    # Stage the whole scene on device once; per-step indexing is a device
+    # gather instead of a host->device copy (the remote-TPU tunnel makes
+    # per-iteration transfers the bottleneck otherwise).
+    all_b = batch_frames(ds, np.arange(len(ds)))
+    images_d, coords_d = all_b["images"], all_b["coords_gt"]
+    masks_d = (jnp.abs(coords_d).sum(-1) > 1e-9).astype(jnp.float32)
+
+    rng = np.random.default_rng(args.seed)
+    t0 = time.time()
+    loss = float("nan")
+    for it, idx in enumerate(epoch_batches(rng, len(ds), args.batch)):
+        if it >= args.iterations:
+            break
+        idx = jnp.asarray(idx)
+        params, opt_state, loss = step(
+            params, opt_state, images_d[idx], coords_d[idx], masks_d[idx]
+        )
+        if it % max(1, args.iterations // 20) == 0:
+            print(f"iter {it:7d}  coord L1 {float(loss):.4f}  "
+                  f"({(time.time() - t0):.0f}s)", flush=True)
+
+    out = args.output or f"ckpt_expert_{args.scene}"
+    save_checkpoint(out, params, {
+        "kind": "expert",
+        "size": args.size,
+        "scene": args.scene,
+        "scene_center": [float(x) for x in center],
+        "final_loss": float(loss),
+    })
+    print(f"saved {out}  final coord L1 {float(loss):.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

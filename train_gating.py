@@ -1,0 +1,75 @@
+#!/usr/bin/env python3
+"""Stage 2: train the gating network to classify scenes/experts.
+
+Reference counterpart: ``train_gating.py`` (SURVEY.md §2 #10, §3.2).
+
+    python train_gating.py chess fire heads --root datasets/7scenes
+    python train_gating.py synth0 synth1 synth2 --size test --iterations 300
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import numpy as np
+import optax
+
+from esac_tpu.cli import (
+    batch_frames, common_parser, epoch_batches, make_gating, maybe_force_cpu,
+    open_scene,
+)
+from esac_tpu.train import make_gating_train_step
+from esac_tpu.utils.checkpoint import save_checkpoint
+
+
+def main(argv=None) -> int:
+    p = common_parser(__doc__)
+    p.add_argument("scenes", nargs="+", help="scene names in expert order")
+    p.add_argument("--output", default="ckpt_gating")
+    args = p.parse_args(argv)
+    maybe_force_cpu(args)
+
+    datasets = [
+        open_scene(args.root, s, "training", expert=i)
+        for i, s in enumerate(args.scenes)
+    ]
+    M = len(datasets)
+    net = make_gating(args.size, M)
+    probe = batch_frames(datasets[0], np.array([0]))
+    params = net.init(jax.random.key(args.seed), probe["images"])
+
+    opt = optax.adam(optax.cosine_decay_schedule(args.learningrate, args.iterations, 0.05))
+    opt_state = opt.init(params)
+    step = make_gating_train_step(net, opt)
+
+    import jax.numpy as jnp
+
+    # Stage all scenes on device once (see train_expert.py).
+    staged = [batch_frames(d, np.arange(len(d))) for d in datasets]
+    images_d = jnp.concatenate([b["images"] for b in staged])
+    labels_d = jnp.concatenate([b["labels"] for b in staged])
+
+    rng = np.random.default_rng(args.seed)
+    t0 = time.time()
+    loss = float("nan")
+    for it in range(args.iterations):
+        idx = jnp.asarray(rng.integers(0, images_d.shape[0], size=args.batch))
+        params, opt_state, loss = step(params, opt_state, images_d[idx], labels_d[idx])
+        if it % max(1, args.iterations // 20) == 0:
+            print(f"iter {it:7d}  CE {float(loss):.4f}  ({time.time() - t0:.0f}s)",
+                  flush=True)
+
+    save_checkpoint(args.output, params, {
+        "kind": "gating",
+        "size": args.size,
+        "scenes": args.scenes,
+        "final_loss": float(loss),
+    })
+    print(f"saved {args.output}  final CE {float(loss):.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
