@@ -1,0 +1,81 @@
+#!/usr/bin/env python3
+"""Convert the Stanford 12-Scenes release into the common esac_tpu layout.
+
+Reference counterpart: ``datasets/setup_12scenes.py`` (SURVEY.md §2 #14).
+No network egress here, so this converts an already-downloaded release:
+
+    python datasets/setup_12scenes.py --source /data/12scenes --dest datasets/12scenes
+
+Source layout (per scene, e.g. ``apt1/kitchen``):
+    data/frame-XXXXXX.color.jpg      RGB (1296x968)
+    data/frame-XXXXXX.pose.txt       4x4 camera-to-world pose
+    data/frame-XXXXXX.depth.png      16-bit depth (mm)
+    split.txt (optional)             first line "sequence0 frames=N" test count
+
+12-Scenes ships no train/test split files; following common practice (and
+the reference's setup), the FIRST ``--test-frames`` frames form the test set
+and the rest train.  Focal length: f = 572 px at the 1296x968 resolution
+(the loader rescales images; calibration rides along per frame).
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+from setup_7scenes import _link  # same hard-link helper
+
+SCENES = (
+    "apt1/kitchen", "apt1/living",
+    "apt2/bed", "apt2/kitchen", "apt2/living", "apt2/luke",
+    "office1/gates362", "office1/gates381", "office1/lounge", "office1/manolis",
+    "office2/5a", "office2/5b",
+)
+FOCAL = 572.0
+
+
+def convert_scene(source: pathlib.Path, dest: pathlib.Path, scene: str,
+                  test_frames: int) -> int:
+    data = source / scene / "data"
+    colors = sorted(data.glob("frame-*.color.jpg")) + sorted(
+        data.glob("frame-*.color.png")
+    )
+    flat = scene.replace("/", "_")
+    n = 0
+    for i, color in enumerate(colors):
+        split = "test" if i < test_frames else "training"
+        out = dest / flat / split
+        stem = color.name.split(".")[0]
+        _link(color, out / "rgb" / f"{stem}{color.suffix}")
+        _link(data / f"{stem}.pose.txt", out / "poses" / f"{stem}.txt")
+        depth = data / f"{stem}.depth.png"
+        if depth.exists():
+            _link(depth, out / "depth" / f"{stem}.png")
+        calib = out / "calibration" / f"{stem}.txt"
+        calib.parent.mkdir(parents=True, exist_ok=True)
+        calib.write_text(f"{FOCAL}\n")
+        n += 1
+    return n
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--source", required=True)
+    p.add_argument("--dest", default="datasets/12scenes")
+    p.add_argument("--scenes", nargs="*", default=list(SCENES))
+    p.add_argument("--test-frames", type=int, default=200,
+                   help="first N frames of each scene form the test split")
+    args = p.parse_args(argv)
+    source, dest = pathlib.Path(args.source), pathlib.Path(args.dest)
+    for scene in args.scenes:
+        if not (source / scene / "data").is_dir():
+            print(f"skip {scene}: not found under {source}")
+            continue
+        n = convert_scene(source, dest, scene, args.test_frames)
+        print(f"{scene}: {n} frames")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

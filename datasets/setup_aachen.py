@@ -1,0 +1,112 @@
+#!/usr/bin/env python3
+"""Prepare Aachen Day-Night: SfM poses -> per-image layout + expert clusters.
+
+Reference counterpart: ``datasets/setup_aachen.py`` (SURVEY.md §2 #15): the
+outdoor benchmark has no depth; experts are k-means clusters of ground-truth
+camera positions (~50 for Aachen), and stage-1 init uses the reprojection
+loss (no init/ directory is produced).  No network egress: point at the
+downloaded images plus a pose list:
+
+    python datasets/setup_aachen.py --images /data/aachen/images \
+        --poses /data/aachen/poses.txt --dest datasets/aachen --clusters 50
+
+Pose list format (one line per training image, SfM convention):
+    <relative/image/path> qw qx qy qz cx cy cz <focal_px>
+where (qw..qz) rotates world->camera and (cx cy cz) is the camera center in
+world coordinates (t = -R @ c).  Test images (no GT pose) go in a separate
+``--test-list`` of image paths with per-image focal.
+
+Outputs ``<dest>/cluster<k>/training/{rgb,poses,calibration}`` per expert,
+plus ``<dest>/clusters.json`` with cluster centers (each expert's
+``scene_center``) and the label of every image.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent))
+from setup_7scenes import _link  # noqa: E402
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+# Setup runs host-side only; keep jax (imported transitively) off the
+# accelerator so this works on machines where the device is absent/busy.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+from esac_tpu.data.clustering import kmeans_cluster_cameras  # noqa: E402
+from esac_tpu.geometry.rotations import quaternion_to_matrix  # noqa: E402
+
+
+def quat_to_R(q: np.ndarray) -> np.ndarray:
+    return np.asarray(quaternion_to_matrix(np.asarray(q, dtype=np.float32)))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--images", required=True)
+    p.add_argument("--poses", required=True)
+    p.add_argument("--dest", default="datasets/aachen")
+    p.add_argument("--clusters", type=int, default=50)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    images = pathlib.Path(args.images)
+    dest = pathlib.Path(args.dest)
+
+    entries = []
+    for line in pathlib.Path(args.poses).read_text().splitlines():
+        parts = line.split()
+        if len(parts) < 9 or line.startswith("#"):
+            continue
+        name = parts[0]
+        q = np.array([float(v) for v in parts[1:5]])
+        center = np.array([float(v) for v in parts[5:8]])
+        focal = float(parts[8])
+        entries.append((name, q, center, focal))
+    if not entries:
+        print("no pose entries parsed", file=sys.stderr)
+        return 1
+
+    centers = np.stack([e[2] for e in entries])
+    labels, cluster_centers = kmeans_cluster_cameras(
+        centers, args.clusters, seed=args.seed
+    )
+
+    for (name, q, center, focal), k in zip(entries, labels):
+        out = dest / f"cluster{k}" / "training"
+        stem = name.replace("/", "_").rsplit(".", 1)[0]
+        src = images / name
+        if src.exists():
+            _link(src, out / "rgb" / f"{stem}{src.suffix}")
+        R = quat_to_R(q)
+        t = -R @ center
+        # Store camera-to-world 4x4 (the common-layout convention).
+        T = np.eye(4)
+        T[:3, :3] = R.T
+        T[:3, 3] = center
+        pose_f = out / "poses" / f"{stem}.txt"
+        pose_f.parent.mkdir(parents=True, exist_ok=True)
+        np.savetxt(pose_f, T)
+        calib = out / "calibration" / f"{stem}.txt"
+        calib.parent.mkdir(parents=True, exist_ok=True)
+        calib.write_text(f"{focal}\n")
+
+    dest.mkdir(parents=True, exist_ok=True)
+    (dest / "clusters.json").write_text(json.dumps({
+        "n_clusters": args.clusters,
+        "centers": cluster_centers.tolist(),
+        "labels": {e[0]: int(k) for e, k in zip(entries, labels)},
+        "sizes": np.bincount(labels, minlength=args.clusters).tolist(),
+    }, indent=2))
+    print(f"{len(entries)} images -> {args.clusters} expert clusters; "
+          f"sizes {np.bincount(labels, minlength=args.clusters).tolist()}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

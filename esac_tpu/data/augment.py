@@ -1,0 +1,97 @@
+"""Training-time augmentation: in-plane rotation, scale, brightness.
+
+The reference augments stage-1 training with scale/rotation/brightness
+jitter (SURVEY.md §2 #8, [P-med]).  Geometric augmentations must stay
+consistent with the supervision:
+
+- **in-plane rotation** by angle a: the image rotates; the ground-truth pose
+  becomes ``Rz(a) @ (R, t)`` (camera rotates about its optical axis), and GT
+  scene coordinates are resampled from the rotated coordinate map.  Here we
+  rotate the *camera*, not the pixels: both image and coordinate map are
+  resampled with the same inverse-rotation warp about the principal point.
+- **scale** by s: resampling the image by s is equivalent to multiplying the
+  focal length by s; the pose and scene coordinates are unchanged.
+- **brightness/contrast**: photometric only.
+
+All warps are bilinear ``jax.scipy.ndimage.map_coordinates`` on fixed grids
+— static shapes, jit/vmap-safe.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.ndimage import map_coordinates
+
+from esac_tpu.geometry.rotations import rodrigues, so3_log
+from esac_tpu.utils.precision import hmm
+
+
+def _warp_resample(
+    img: jnp.ndarray, angle: jnp.ndarray, scale: jnp.ndarray, order: int = 1
+) -> jnp.ndarray:
+    """Rotate by `angle` and zoom by `scale` about the center of (H, W, C)."""
+    H, W = img.shape[:2]
+    cy, cx = (H - 1) / 2.0, (W - 1) / 2.0
+    ys = jnp.arange(H) - cy
+    xs = jnp.arange(W) - cx
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ca, sa = jnp.cos(angle) / scale, jnp.sin(angle) / scale
+    # Inverse warp: output pixel samples input at rotation by -angle, zoom 1/s.
+    src_x = ca * gx - sa * gy + cx
+    src_y = sa * gx + ca * gy + cy
+    coords = jnp.stack([src_y.reshape(-1), src_x.reshape(-1)])
+
+    def chan(c):
+        return map_coordinates(img[..., c], coords, order=order, mode="nearest").reshape(H, W)
+
+    return jnp.stack([chan(c) for c in range(img.shape[-1])], axis=-1)
+
+
+def augment_frame(
+    key: jax.Array,
+    image: jnp.ndarray,
+    coords_gt: jnp.ndarray,
+    rvec: jnp.ndarray,
+    tvec: jnp.ndarray,
+    focal: jnp.ndarray,
+    max_rotation_deg: float = 30.0,
+    scale_range: tuple[float, float] = (0.8, 1.2),
+    brightness: float = 0.15,
+) -> dict:
+    """Jointly augment (image, GT coords, pose, focal); returns a dict.
+
+    image: (H, W, 3); coords_gt: (h, w, 3).  The returned pose/focal/coords
+    remain geometrically consistent: reprojecting the new coords through the
+    new pose/focal matches the new image.
+    """
+    k_rot, k_scale, k_bright = jax.random.split(key, 3)
+    angle = jnp.radians(
+        jax.random.uniform(k_rot, (), minval=-max_rotation_deg, maxval=max_rotation_deg)
+    )
+    scale = jax.random.uniform(k_scale, (), minval=scale_range[0], maxval=scale_range[1])
+    gain = 1.0 + jax.random.uniform(k_bright, (), minval=-brightness, maxval=brightness)
+
+    # One combined inverse warp, applied identically to image and coord map
+    # (their continuous centers coincide for stride-aligned grids):
+    # - rotation: with the warp new(q) = old(R(angle) q), the new camera is
+    #   the old one rotated by -angle about its optical axis, so the
+    #   scene->camera pose picks up Rz(-angle) on the left (projection
+    #   commutes with in-plane rotation: proj(Rz(b) Y) = R(b) proj(Y));
+    # - zoom about the principal point: exactly equivalent to focal *= scale,
+    #   pose unchanged.
+    image_aug = _warp_resample(image, angle, scale)
+    coords_aug = _warp_resample(coords_gt, angle, scale)
+    Rz = rodrigues(jnp.array([0.0, 0.0, -1.0]) * angle)
+    R_new = hmm(Rz, rodrigues(rvec))
+    t_new = hmm(Rz, tvec[:, None])[:, 0]
+
+    image_aug = jnp.clip(image_aug * gain, 0.0, 1.0)
+    return {
+        "image": image_aug,
+        "coords_gt": coords_aug,
+        "rvec": so3_log(R_new),
+        "tvec": t_new,
+        "focal": focal * scale,
+        "scale": scale,
+    }

@@ -1,0 +1,73 @@
+"""Expert clustering: partition a large scene into expert regions.
+
+Reference counterpart: the Aachen setup's k-means over ground-truth camera
+positions, whose ~50 clusters define the experts (SURVEY.md §2 #15, §0).
+The cluster assignment supplies (a) the GT expert label for gating training
+and (b) each expert's ``scene_center``.  Deterministic k-means++ in numpy —
+this runs once at dataset-setup time, not in the training hot path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def kmeans_cluster_cameras(
+    positions: np.ndarray,
+    n_clusters: int,
+    seed: int = 0,
+    iters: int = 100,
+) -> tuple[np.ndarray, np.ndarray]:
+    """k-means over camera positions. positions: (N, 3).
+
+    Returns (labels (N,), centers (n_clusters, 3)).  k-means++ init for
+    stability, empty clusters re-seeded from the farthest point.
+    """
+    positions = np.asarray(positions, dtype=np.float64)
+    n = positions.shape[0]
+    if n_clusters > n:
+        raise ValueError(f"{n_clusters} clusters for {n} cameras")
+    rng = np.random.default_rng(seed)
+
+    # k-means++ seeding.
+    centers = [positions[rng.integers(n)]]
+    for _ in range(1, n_clusters):
+        d2 = np.min(
+            ((positions[:, None] - np.stack(centers)[None]) ** 2).sum(-1), axis=1
+        )
+        prob = d2 / (d2.sum() + 1e-12)
+        centers.append(positions[rng.choice(n, p=prob)])
+    centers = np.stack(centers)
+
+    labels = np.zeros(n, dtype=np.int64)
+    for _ in range(iters):
+        d2 = ((positions[:, None] - centers[None]) ** 2).sum(-1)
+        new_labels = d2.argmin(axis=1)
+        if np.array_equal(new_labels, labels) and _ > 0:
+            break
+        labels = new_labels
+        for k in range(n_clusters):
+            mask = labels == k
+            if mask.any():
+                centers[k] = positions[mask].mean(axis=0)
+            else:
+                # Re-seed an empty cluster at the point farthest from its center.
+                far = d2.min(axis=1).argmax()
+                centers[k] = positions[far]
+    return labels.astype(np.int64), centers.astype(np.float32)
+
+
+def cluster_scene(dataset, n_clusters: int, seed: int = 0):
+    """Cluster a SceneDataset's frames into expert regions.
+
+    Returns (labels, centers) using each frame's camera center -R^T t.
+    """
+    from esac_tpu.geometry.rotations import rodrigues
+    import jax.numpy as jnp
+
+    centers_cam = []
+    for i in range(len(dataset)):
+        f = dataset[i]
+        R = np.asarray(rodrigues(jnp.asarray(f.rvec)))
+        centers_cam.append(-R.T @ f.tvec)
+    return kmeans_cluster_cameras(np.stack(centers_cam), n_clusters, seed)

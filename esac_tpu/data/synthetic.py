@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from esac_tpu.geometry.rotations import rodrigues
 
@@ -22,8 +23,9 @@ from esac_tpu.geometry.rotations import rodrigues
 CAMERA_F = 525.0
 CAMERA_C = (320.0, 240.0)
 
-# The room: axis-aligned box [0, ROOM_SIZE]^3 (meters).
-ROOM_SIZE = jnp.array([6.0, 4.0, 3.0])
+# The room: axis-aligned box [0, ROOM_SIZE]^3 (meters).  numpy, not jnp:
+# module-level jnp arrays initialize the device backend at import time.
+ROOM_SIZE = np.array([6.0, 4.0, 3.0], dtype=np.float32)
 
 
 def output_pixel_grid(

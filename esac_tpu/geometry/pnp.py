@@ -37,11 +37,6 @@ from esac_tpu.geometry.rotations import rodrigues, so3_log
 from esac_tpu.utils.num import safe_norm, safe_sqrt
 from esac_tpu.utils.precision import hmm
 
-# Pair indices of the 6 unordered pairs of 4 points.
-_PAIR_I = jnp.array([0, 0, 0, 1, 1, 2])
-_PAIR_J = jnp.array([1, 2, 3, 2, 3, 3])
-
-
 def bearings(x2d: jnp.ndarray, f: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
     """Pixels -> unit bearing vectors in the camera frame. (..., N, 2) -> (..., N, 3)."""
     xy = (x2d - c) / f

@@ -107,6 +107,24 @@ def so3_log(R: jnp.ndarray) -> jnp.ndarray:
     return rvec
 
 
+def quaternion_to_matrix(q: jnp.ndarray) -> jnp.ndarray:
+    """Unit quaternion (w, x, y, z) -> rotation matrix. (..., 4) -> (..., 3, 3).
+
+    Used by the Aachen/SfM pose import (datasets/setup_aachen.py;
+    reconstruction formats store quaternions); normalizes defensively.
+    """
+    q = q / safe_norm(q)[..., None]
+    w, x, y, z = q[..., 0], q[..., 1], q[..., 2], q[..., 3]
+    return jnp.stack(
+        [
+            jnp.stack([1 - 2 * (y * y + z * z), 2 * (x * y - w * z), 2 * (x * z + w * y)], -1),
+            jnp.stack([2 * (x * y + w * z), 1 - 2 * (x * x + z * z), 2 * (y * z - w * x)], -1),
+            jnp.stack([2 * (x * z - w * y), 2 * (y * z + w * x), 1 - 2 * (x * x + y * y)], -1),
+        ],
+        axis=-2,
+    )
+
+
 def rotation_angle_deg(R: jnp.ndarray) -> jnp.ndarray:
     """Rotation angle of R in degrees. (..., 3, 3) -> (...).
 

@@ -1,0 +1,77 @@
+"""Profiling: stage timers + the hypotheses/sec/chip counter.
+
+The reference prints ad-hoc wall-clock stage times from a C++ StopWatch
+(SURVEY.md §2 #6, §5).  Under XLA, wall-clock around an async dispatch
+measures nothing — every timer here fences with ``block_until_ready``.
+``jax.profiler`` traces (TensorBoard) can be layered on via ``trace``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+import jax
+
+
+class StageTimer:
+    """Accumulates fenced wall-clock per named stage.
+
+    >>> t = StageTimer()
+    >>> with t("solve"):
+    ...     out = kernel(...)        # timer fences on exit
+    >>> t.summary()
+    """
+
+    def __init__(self):
+        self.totals: dict[str, float] = defaultdict(float)
+        self.counts: dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def __call__(self, name: str, fence=None):
+        t0 = time.perf_counter()
+        holder = []
+        try:
+            yield holder
+        finally:
+            target = holder[0] if holder else fence
+            if target is not None:
+                jax.block_until_ready(target)
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def summary(self) -> str:
+        lines = []
+        for name, total in sorted(self.totals.items(), key=lambda kv: -kv[1]):
+            n = self.counts[name]
+            lines.append(f"{name:24s} {1e3 * total:10.1f} ms total "
+                         f"{1e3 * total / n:8.2f} ms/call x{n}")
+        return "\n".join(lines)
+
+
+def hypotheses_per_sec(
+    fn,
+    args: tuple,
+    n_hyps_per_call: int,
+    repeats: int = 20,
+) -> float:
+    """The north-star counter (BASELINE.md): fenced throughput of a jitted
+    hypothesis-kernel callable."""
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return repeats * n_hyps_per_call / (time.perf_counter() - t0)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "/tmp/esac_tpu_trace"):
+    """jax.profiler trace for TensorBoard, as a context manager."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()

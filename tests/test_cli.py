@@ -1,0 +1,67 @@
+"""End-to-end CLI smoke tests: the four entry scripts over synthetic scenes.
+
+Everything runs --cpu with tiny budgets; this validates the script surface,
+checkpoint round-trips and backend dispatch, not accuracy (the TPU runs and
+test_end_to_end.py cover quality).
+"""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def run(script, *args, timeout=900):
+    r = subprocess.run(
+        [sys.executable, str(REPO / script), *args],
+        capture_output=True, text=True, cwd=REPO, timeout=timeout,
+    )
+    assert r.returncode == 0, f"{script} failed:\n{r.stdout}\n{r.stderr}"
+    return r.stdout
+
+
+@pytest.fixture(scope="module")
+def pipeline_ckpts(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ckpts")
+    common = ["--cpu", "--size", "test", "--batch", "2", "--learningrate", "1e-3"]
+    run("train_expert.py", "synth0", *common, "--iterations", "4",
+        "--output", str(d / "e0"))
+    run("train_expert.py", "synth1", *common, "--iterations", "4",
+        "--output", str(d / "e1"))
+    run("train_gating.py", "synth0", "synth1", *common, "--iterations", "4",
+        "--output", str(d / "g"))
+    return d
+
+
+def test_train_expert_writes_checkpoint(pipeline_ckpts):
+    d = pipeline_ckpts
+    assert (d / "e0" / "config.json").exists()
+    assert (d / "e0" / "params").exists()
+
+
+def test_train_esac_end_to_end(pipeline_ckpts):
+    d = pipeline_ckpts
+    out = run(
+        "train_esac.py", "synth0", "synth1", "--cpu", "--size", "test",
+        "--iterations", "2", "--batch", "2", "--hypotheses", "16",
+        "--experts", str(d / "e0"), str(d / "e1"), "--gating", str(d / "g"),
+        "--output", str(d / "esac"),
+    )
+    assert "E[pose loss]" in out
+    assert (d / "esac_gating" / "config.json").exists()
+
+
+@pytest.mark.parametrize("backend", ["jax", "cpp"])
+def test_test_esac_reports_metrics(pipeline_ckpts, backend):
+    d = pipeline_ckpts
+    out = run(
+        "test_esac.py", "synth0", "synth1", "--cpu", "--size", "test",
+        "--backend", backend, "--hypotheses", "16", "--limit", "2",
+        "--experts", str(d / "e0"), str(d / "e1"), "--gating", str(d / "g"),
+    )
+    assert "median rot err" in out
+    assert "5cm/5deg" in out
+    assert f"backend={backend}" in out
