@@ -11,27 +11,36 @@ argmax select -> IRLS refine.  The cpp baseline is the self-contained
 C++/OpenMP backend (esac_cpp/), the stand-in for the reference's
 CPU-extension path measured on this host; the north-star target is >=20x
 (BASELINE.json).
+
+Robustness: the accelerator measurement runs in a *subprocess with a
+timeout* — this container's TPU relay can wedge permanently (backend init
+then blocks forever), and a benchmark that hangs is worse than one that
+degrades.  On timeout the jax path is re-measured on CPU and flagged via a
+"note" field.
 """
 
 from __future__ import annotations
 
 import json
+import subprocess
+import sys
 import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from esac_tpu.data import CAMERA_F, make_correspondence_frame
-from esac_tpu.ransac import RansacConfig, dsac_infer
 
 N_HYPS = 256
 BATCH = 16          # frames vmapped per dispatch to saturate the chip
 REPEATS = 20
 C = (320.0, 240.0)
+DEVICE_TIMEOUT_S = 900
 
 
-def bench_jax() -> float:
+def _measure_jax() -> float:
+    """Measure the jax hypothesis pipeline on the default device."""
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.data import CAMERA_F, make_correspondence_frame
+    from esac_tpu.ransac import RansacConfig, dsac_infer
+
     cfg = RansacConfig(n_hyps=N_HYPS)
     keys = jax.random.split(jax.random.key(0), BATCH)
     frames = [
@@ -56,7 +65,12 @@ def bench_jax() -> float:
     return REPEATS * BATCH * N_HYPS / dt
 
 
-def bench_cpp() -> float | None:
+def _measure_cpp() -> float | None:
+    import jax
+    import numpy as np
+
+    from esac_tpu.data import CAMERA_F, make_correspondence_frame
+
     try:
         from esac_tpu.backends import cpp_available, esac_infer_cpp
 
@@ -79,19 +93,41 @@ def bench_cpp() -> float | None:
 
 
 def main() -> None:
-    jax_rate = bench_jax()
-    cpp_rate = bench_cpp()
-    vs = (jax_rate / cpp_rate) if cpp_rate else None
-    print(
-        json.dumps(
-            {
-                "metric": "pose_hypotheses_per_sec_per_chip",
-                "value": round(jax_rate, 1),
-                "unit": "hyps/s",
-                "vs_baseline": round(vs, 2) if vs is not None else None,
-            }
+    # The parent never touches the accelerator: everything here runs on the
+    # CPU backend; the device measurement is delegated to a child process.
+    note = None
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import bench, json; print(json.dumps(bench._measure_jax()))"],
+            capture_output=True, text=True, timeout=DEVICE_TIMEOUT_S,
+            cwd=__file__.rsplit("/", 1)[0],
         )
-    )
+        jax_rate = json.loads(r.stdout.strip().splitlines()[-1]) if r.returncode == 0 else None
+    except (subprocess.TimeoutExpired, Exception):
+        jax_rate = None
+    if jax_rate is None:
+        note = "device measurement failed/hung; jax path measured on CPU"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax_rate = _measure_jax()
+    else:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    cpp_rate = _measure_cpp()
+    vs = (jax_rate / cpp_rate) if cpp_rate else None
+    out = {
+        "metric": "pose_hypotheses_per_sec_per_chip",
+        "value": round(jax_rate, 1),
+        "unit": "hyps/s",
+        "vs_baseline": round(vs, 2) if vs is not None else None,
+    }
+    if note:
+        out["note"] = note
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

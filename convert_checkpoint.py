@@ -1,0 +1,65 @@
+#!/usr/bin/env python3
+"""Convert a reference torch checkpoint into an esac_tpu checkpoint.
+
+The reference stores ``torch.save(net.state_dict())`` files; this converts
+one into the orbax+config format used here (SURVEY.md §5: checkpoints must
+interchange so cpp- and jax-backend accuracy can be compared like-for-like).
+
+    python convert_checkpoint.py expert chess.pth ckpt_expert_chess \
+        --size ref --scene-center 1.0 2.0 0.5
+    python convert_checkpoint.py gating gating.pth ckpt_gating --experts 7
+
+Layer matching is ordinal (the nets are plain sequential stacks); shape
+mismatches abort with a clear error, which catches architecture drift.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import jax
+import jax.numpy as jnp
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("kind", choices=("expert", "gating"))
+    p.add_argument("torch_path")
+    p.add_argument("output")
+    p.add_argument("--size", default="ref")
+    p.add_argument("--scene-center", nargs=3, type=float, default=(0.0, 0.0, 0.0))
+    p.add_argument("--experts", type=int, default=7, help="gating only")
+    p.add_argument("--height", type=int, default=480)
+    p.add_argument("--width", type=int, default=640)
+    args = p.parse_args(argv)
+    jax.config.update("jax_platforms", "cpu")
+
+    import torch
+
+    from esac_tpu.cli import make_expert, make_gating
+    from esac_tpu.models.convert import torch_state_dict_to_flax
+    from esac_tpu.utils.checkpoint import save_checkpoint
+
+    state = torch.load(args.torch_path, map_location="cpu", weights_only=True)
+    if args.kind == "expert":
+        net = make_expert(args.size, args.scene_center)
+        config = {"kind": "expert", "size": args.size,
+                  "scene_center": list(args.scene_center),
+                  "converted_from": args.torch_path}
+    else:
+        net = make_gating(args.size, args.experts)
+        config = {"kind": "gating", "size": args.size,
+                  "num_experts": args.experts,
+                  "converted_from": args.torch_path}
+    probe = jnp.zeros((1, args.height, args.width, 3))
+    params = net.init(jax.random.key(0), probe)
+    params = {"params": torch_state_dict_to_flax(state, params["params"])}
+    save_checkpoint(args.output, params, config)
+    n = sum(x.size for x in jax.tree.leaves(params))
+    print(f"converted {args.torch_path} -> {args.output} ({n/1e6:.2f}M params)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
