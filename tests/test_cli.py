@@ -65,3 +65,9 @@ def test_test_esac_reports_metrics(pipeline_ckpts, backend):
     assert "median rot err" in out
     assert "5cm/5deg" in out
     assert f"backend={backend}" in out
+
+
+def test_train_expert_augment_flag(tmp_path):
+    run("train_expert.py", "synth0", "--cpu", "--size", "test", "--batch", "2",
+        "--iterations", "3", "--augment", "--output", str(tmp_path / "aug"))
+    assert (tmp_path / "aug" / "config.json").exists()

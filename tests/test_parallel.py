@@ -102,3 +102,27 @@ def test_graft_dryrun_multichip():
     import __graft_entry__
 
     __graft_entry__.dryrun_multichip(8)
+
+
+def test_sharded_esac_many_experts_per_shard():
+    """Config #4 shape (BASELINE.md): M >> devices — 48 experts over 8 shards
+    (6 local experts each), winner found by the cross-shard argmax."""
+    mesh = make_mesh(n_data=1, n_expert=8)
+    correct = 29
+    frame = make_correspondence_frame(jax.random.key(0), noise=0.01, **FRAME_KW)
+    n = frame["coords"].shape[0]
+    maps = [
+        frame["coords"] if m == correct
+        else jax.random.uniform(jax.random.fold_in(jax.random.key(1), m), (n, 3), maxval=5.0)
+        for m in range(48)
+    ]
+    coords_all = jax.device_put(jnp.stack(maps), expert_sharding(mesh))
+    small_cfg = RansacConfig(n_hyps=16, refine_iters=3)
+    rvec, tvec, expert, score = esac_infer_sharded(
+        mesh, jax.random.key(2), coords_all, frame["pixels"], F, C, small_cfg
+    )
+    assert int(expert) == correct
+    r_err, t_err = pose_errors(
+        rodrigues(rvec), tvec, rodrigues(frame["rvec"]), frame["tvec"]
+    )
+    assert r_err < 5.0 and t_err < 0.05

@@ -34,6 +34,8 @@ def main(argv=None) -> int:
     p = common_parser(__doc__)
     p.add_argument("scene", help="scene name (or synthN for the synthetic room)")
     p.add_argument("--output", default=None, help="checkpoint directory")
+    p.add_argument("--augment", action="store_true",
+                   help="rotation/scale/brightness augmentation (see data/augment.py)")
     args = p.parse_args(argv)
     maybe_force_cpu(args)
 
@@ -58,15 +60,38 @@ def main(argv=None) -> int:
     images_d, coords_d = all_b["images"], all_b["coords_gt"]
     masks_d = (jnp.abs(coords_d).sum(-1) > 1e-9).astype(jnp.float32)
 
+    if args.augment:
+        from esac_tpu.data.augment import augment_frame
+
+        rvecs_d, tvecs_d = all_b["rvecs"], all_b["tvecs"]
+        focal_d = jnp.float32(all_b["focal"])
+
+        @jax.jit
+        def augment_batch(key, idx):
+            keys = jax.random.split(key, idx.shape[0])
+            out = jax.vmap(
+                lambda k, im, co, rv, tv: augment_frame(
+                    k, im, co, rv, tv, focal_d
+                )
+            )(keys, images_d[idx], coords_d[idx], rvecs_d[idx], tvecs_d[idx])
+            return out["image"], out["coords_gt"]
+
     rng = np.random.default_rng(args.seed)
+    aug_key = jax.random.key(args.seed + 1)
     t0 = time.time()
     loss = float("nan")
     for it, idx in enumerate(epoch_batches(rng, len(ds), args.batch)):
         if it >= args.iterations:
             break
         idx = jnp.asarray(idx)
+        if args.augment:
+            aug_key, sub = jax.random.split(aug_key)
+            images_b, coords_b = augment_batch(sub, idx)
+            masks_b = (jnp.abs(coords_b).sum(-1) > 1e-9).astype(jnp.float32)
+        else:
+            images_b, coords_b, masks_b = images_d[idx], coords_d[idx], masks_d[idx]
         params, opt_state, loss = step(
-            params, opt_state, images_d[idx], coords_d[idx], masks_d[idx]
+            params, opt_state, images_b, coords_b, masks_b
         )
         if it % max(1, args.iterations // 20) == 0:
             print(f"iter {it:7d}  coord L1 {float(loss):.4f}  "
