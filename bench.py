@@ -33,16 +33,26 @@ C = (320.0, 240.0)
 DEVICE_TIMEOUT_S = 900
 
 
-def _measure_jax() -> float:
-    """Measure the jax hypothesis pipeline on the default device."""
+def _measure_jax(
+    batch: int = BATCH,
+    n_hyps: int = N_HYPS,
+    repeats: int = REPEATS,
+    shard_data: bool = False,
+) -> float:
+    """Fenced per-chip throughput of the jax hypothesis pipeline.
+
+    With ``shard_data`` the batch axis is sharded over all devices (config #5
+    streaming mode); the returned rate is divided by the device count so the
+    metric stays per-chip either way.
+    """
     import jax
     import jax.numpy as jnp
 
     from esac_tpu.data import CAMERA_F, make_correspondence_frame
     from esac_tpu.ransac import RansacConfig, dsac_infer
 
-    cfg = RansacConfig(n_hyps=N_HYPS)
-    keys = jax.random.split(jax.random.key(0), BATCH)
+    cfg = RansacConfig(n_hyps=n_hyps)
+    keys = jax.random.split(jax.random.key(0), batch)
     frames = [
         make_correspondence_frame(k, noise=0.01, outlier_frac=0.3) for k in keys
     ]
@@ -51,18 +61,30 @@ def _measure_jax() -> float:
     f32 = jnp.float32(CAMERA_F)
     c = jnp.asarray(C)
 
+    n_chips = 1
+    n_dev = jax.device_count()
+    if shard_data and n_dev > 1 and batch % n_dev == 0:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from esac_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(n_data=n_dev, n_expert=1)
+        sh = NamedSharding(mesh, P("data"))
+        coords, pixels = jax.device_put(coords, sh), jax.device_put(pixels, sh)
+        n_chips = n_dev
+
     fn = jax.jit(
         jax.vmap(lambda k, co, px: dsac_infer(k, co, px, f32, c, cfg))
     )
-    rkeys = jax.random.split(jax.random.key(1), BATCH)
+    rkeys = jax.random.split(jax.random.key(1), batch)
     out = fn(rkeys, coords, pixels)
     jax.block_until_ready(out["rvec"])  # compile + warm
     t0 = time.perf_counter()
-    for i in range(REPEATS):
-        out = fn(jax.random.split(jax.random.key(2 + i), BATCH), coords, pixels)
+    for i in range(repeats):
+        out = fn(jax.random.split(jax.random.key(2 + i), batch), coords, pixels)
     jax.block_until_ready(out["rvec"])
     dt = time.perf_counter() - t0
-    return REPEATS * BATCH * N_HYPS / dt
+    return repeats * batch * n_hyps / dt / n_chips
 
 
 def _measure_cpp() -> float | None:
@@ -93,6 +115,15 @@ def _measure_cpp() -> float | None:
 
 
 def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "streaming":
+        # Development mode (BASELINE.md config #5: 64 frames x 4096 hyps,
+        # data-parallel over all devices); the driver uses the no-arg path.
+        rate = _measure_jax(batch=64, n_hyps=4096, repeats=5, shard_data=True)
+        print(json.dumps({
+            "metric": "streaming_hypotheses_per_sec_per_chip",
+            "value": round(rate, 1), "unit": "hyps/s", "vs_baseline": None,
+        }))
+        return
     # The parent never touches the accelerator: everything here runs on the
     # CPU backend; the device measurement is delegated to a child process.
     note = None

@@ -440,4 +440,48 @@ int esac_cpp_infer(const float* coords, const float* pixels, int n_cells,
   return n_valid;
 }
 
+// Multi-expert ESAC loop: per-expert hypothesis pools scored on their own
+// coordinate maps, global winner refined on its expert's map (the native
+// counterpart of esac_tpu.ransac.esac.esac_infer; the reference's extension
+// owns this loop too, SURVEY.md §3.3).  coords_all: (n_experts, n_cells, 3).
+// Returns the winning expert index, or -1 if every solve failed.
+int esac_cpp_infer_multi(const float* coords_all, const float* pixels,
+                         int n_experts, int n_cells, float f, float cx,
+                         float cy, int n_hyps_per_expert, float tau,
+                         float beta, int refine_iters, uint64_t seed,
+                         double* out_R, double* out_t, double* out_score,
+                         double* out_expert_scores) {
+  int best_expert = -1;
+  double best_score = -1.0;
+  double best_R[9], best_t[3];
+  for (int m = 0; m < n_experts; m++) {
+    const float* coords = coords_all + static_cast<size_t>(m) * n_cells * 3;
+    double R[9], t[3], score = -1.0;
+    // Defer refinement until the global winner is known (refine_iters=0);
+    // per-expert scores still reflect the unrefined best, as in the jax path.
+    int n_valid = esac_cpp_infer(coords, pixels, n_cells, f, cx, cy,
+                                 n_hyps_per_expert, tau, beta, /*refine=*/0,
+                                 seed + static_cast<uint64_t>(m) * 0x51ed270b, R,
+                                 t, &score, nullptr);
+    if (out_expert_scores) out_expert_scores[m] = (n_valid > 0) ? score : -1.0;
+    if (n_valid > 0 && score > best_score) {
+      best_score = score;
+      best_expert = m;
+      std::memcpy(best_R, R, sizeof(R));
+      std::memcpy(best_t, t, sizeof(t));
+    }
+  }
+  if (best_expert < 0) return -1;
+  const float* coords =
+      coords_all + static_cast<size_t>(best_expert) * n_cells * 3;
+  for (int it = 0; it < refine_iters; it++)
+    gn_step(best_R, best_t, coords, pixels, n_cells, f, cx, cy, tau, beta);
+  best_score =
+      score_pose(best_R, best_t, coords, pixels, n_cells, f, cx, cy, tau, beta);
+  std::memcpy(out_R, best_R, sizeof(best_R));
+  std::memcpy(out_t, best_t, sizeof(best_t));
+  *out_score = best_score;
+  return best_expert;
+}
+
 }  // extern "C"

@@ -60,6 +60,21 @@ def _load() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_double),  # out_score
         ctypes.POINTER(ctypes.c_double),  # out_scores (may be NULL)
     ]
+    lib.esac_cpp_infer_multi.restype = ctypes.c_int
+    lib.esac_cpp_infer_multi.argtypes = [
+        ctypes.POINTER(ctypes.c_float),   # coords_all
+        ctypes.POINTER(ctypes.c_float),   # pixels
+        ctypes.c_int, ctypes.c_int,       # n_experts, n_cells
+        ctypes.c_float, ctypes.c_float, ctypes.c_float,  # f, cx, cy
+        ctypes.c_int,                     # n_hyps_per_expert
+        ctypes.c_float, ctypes.c_float,   # tau, beta
+        ctypes.c_int,                     # refine_iters
+        ctypes.c_uint64,                  # seed
+        ctypes.POINTER(ctypes.c_double),  # out_R
+        ctypes.POINTER(ctypes.c_double),  # out_t
+        ctypes.POINTER(ctypes.c_double),  # out_score
+        ctypes.POINTER(ctypes.c_double),  # out_expert_scores (may be NULL)
+    ]
     _lib = lib
     return lib
 
@@ -116,3 +131,47 @@ def esac_infer_cpp(
     if return_scores:
         out["scores"] = scores
     return out
+
+
+def esac_infer_multi_cpp(
+    coords_all: np.ndarray,
+    pixels: np.ndarray,
+    f: float,
+    c: tuple[float, float],
+    n_hyps_per_expert: int = 256,
+    tau: float = 10.0,
+    beta: float = 0.5,
+    refine_iters: int = 8,
+    seed: int = 0,
+) -> dict:
+    """Multi-expert hypothesis loop on the CPU backend.
+
+    coords_all: (M, N, 3) float32 per-expert scene coordinates.
+    Returns dict with 'R', 't', 'score', 'expert' (winner index, -1 if all
+    solves failed) and 'expert_scores' (M,).
+    """
+    lib = _load()
+    coords_all = np.ascontiguousarray(coords_all, dtype=np.float32)
+    pixels = np.ascontiguousarray(pixels, dtype=np.float32)
+    M, n = coords_all.shape[0], coords_all.shape[1]
+    out_R = np.zeros(9, dtype=np.float64)
+    out_t = np.zeros(3, dtype=np.float64)
+    out_score = np.zeros(1, dtype=np.float64)
+    expert_scores = np.zeros(M, dtype=np.float64)
+
+    def ptr(a, ty):
+        return a.ctypes.data_as(ctypes.POINTER(ty))
+
+    expert = lib.esac_cpp_infer_multi(
+        ptr(coords_all, ctypes.c_float), ptr(pixels, ctypes.c_float), M, n,
+        f, c[0], c[1], n_hyps_per_expert, tau, beta, refine_iters, seed,
+        ptr(out_R, ctypes.c_double), ptr(out_t, ctypes.c_double),
+        ptr(out_score, ctypes.c_double), ptr(expert_scores, ctypes.c_double),
+    )
+    return {
+        "R": out_R.reshape(3, 3),
+        "t": out_t,
+        "score": float(out_score[0]),
+        "expert": int(expert),
+        "expert_scores": expert_scores,
+    }

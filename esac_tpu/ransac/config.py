@@ -34,3 +34,8 @@ class RansacConfig:
     # Clamp on the per-hypothesis pose loss (degrees-equivalent units) so a
     # few wild hypotheses cannot dominate the training expectation.
     loss_clamp: float = 100.0
+    # Rematerialize the per-hypothesis refinement in the backward pass
+    # (jax.checkpoint): trades ~2x refine FLOPs for O(n_hyps * n_cells)
+    # activation memory — needed for config-#5-scale training
+    # (4096 hypotheses x 4800 cells) on one chip's HBM.
+    remat: bool = False

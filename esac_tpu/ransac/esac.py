@@ -110,13 +110,13 @@ def _expected_losses_per_expert(rvecs, tvecs, scores, coords_all, pixels, f, c, 
 
     def one_expert(rv, tv, sc, co):
         probs = jax.nn.softmax(cfg.alpha * sc)
-        refine = jax.vmap(
-            lambda r, t: refine_soft_inliers(
-                r, t, co, pixels, f, c, cfg.tau, cfg.beta,
-                iters=cfg.train_refine_iters,
-            )
+        refine_one = lambda r, t: refine_soft_inliers(  # noqa: E731
+            r, t, co, pixels, f, c, cfg.tau, cfg.beta,
+            iters=cfg.train_refine_iters,
         )
-        rv_r, tv_r = refine(rv, tv)
+        if cfg.remat:
+            refine_one = jax.checkpoint(refine_one)
+        rv_r, tv_r = jax.vmap(refine_one)(rv, tv)
         losses = jax.vmap(lambda r, t: pose_loss(r, t, R_gt, t_gt, cfg))(rv_r, tv_r)
         return jnp.sum(probs * losses), losses
 
@@ -193,13 +193,13 @@ def esac_train_loss(
     scores = soft_inlier_score(errors, cfg.tau, cfg.beta)
     probs = jax.nn.softmax(cfg.alpha * scores)
 
-    refine = jax.vmap(
-        lambda rv, tv, co: refine_soft_inliers(
-            rv, tv, co, pixels, f, c, cfg.tau, cfg.beta,
-            iters=cfg.train_refine_iters,
-        )
+    refine_one = lambda rv, tv, co: refine_soft_inliers(  # noqa: E731
+        rv, tv, co, pixels, f, c, cfg.tau, cfg.beta,
+        iters=cfg.train_refine_iters,
     )
-    rvecs_r, tvecs_r = refine(rvecs, tvecs, coords_sel)
+    if cfg.remat:
+        refine_one = jax.checkpoint(refine_one)
+    rvecs_r, tvecs_r = jax.vmap(refine_one)(rvecs, tvecs, coords_sel)
     losses = jax.vmap(lambda rv, tv: pose_loss(rv, tv, R_gt, t_gt, cfg))(
         rvecs_r, tvecs_r
     )

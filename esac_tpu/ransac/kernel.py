@@ -139,13 +139,13 @@ def dsac_train_loss(
     scores = soft_inlier_score(errors, cfg.tau, cfg.beta)
     probs = jax.nn.softmax(cfg.alpha * scores)
 
-    refine = jax.vmap(
-        lambda rv, tv: refine_soft_inliers(
-            rv, tv, coords, pixels, f, c, cfg.tau, cfg.beta,
-            iters=cfg.train_refine_iters,
-        )
+    refine_one = lambda rv, tv: refine_soft_inliers(  # noqa: E731
+        rv, tv, coords, pixels, f, c, cfg.tau, cfg.beta,
+        iters=cfg.train_refine_iters,
     )
-    rvecs_r, tvecs_r = refine(rvecs, tvecs)
+    if cfg.remat:
+        refine_one = jax.checkpoint(refine_one)
+    rvecs_r, tvecs_r = jax.vmap(refine_one)(rvecs, tvecs)
     losses = jax.vmap(lambda rv, tv: pose_loss(rv, tv, R_gt, t_gt, cfg))(
         rvecs_r, tvecs_r
     )

@@ -88,20 +88,16 @@ def main(argv=None) -> int:
                 expert = int(out["expert"])
                 R_est = rodrigues(rvec)
             else:
-                from esac_tpu.backends import esac_infer_cpp
+                from esac_tpu.backends import esac_infer_multi_cpp
 
-                best = None
-                for m in range(M):
-                    r = esac_infer_cpp(
-                        np.asarray(coords_all[m]), np.asarray(pixels),
-                        float(focal), (W / 2.0, H / 2.0),
-                        n_hyps=args.hypotheses, seed=n_total * M + m,
-                    )
-                    if best is None or r["score"] > best[0]["score"]:
-                        best = (r, m)
-                expert = best[1]
-                R_est = jnp.asarray(best[0]["R"], jnp.float32)
-                tvec = jnp.asarray(best[0]["t"], jnp.float32)
+                r = esac_infer_multi_cpp(
+                    np.asarray(coords_all), np.asarray(pixels),
+                    float(focal), (W / 2.0, H / 2.0),
+                    n_hyps_per_expert=args.hypotheses, seed=n_total,
+                )
+                expert = r["expert"]
+                R_est = jnp.asarray(r["R"], jnp.float32)
+                tvec = jnp.asarray(r["t"], jnp.float32)
             times.append(time.perf_counter() - t0)
             r_err, t_err = pose_errors(
                 R_est, tvec, rodrigues(jnp.asarray(fr.rvec)), jnp.asarray(fr.tvec)

@@ -85,3 +85,27 @@ def test_cpp_score_distribution_sane():
     jax_frac = float((soft_inlier_score(errors, cfg.tau, cfg.beta) > 0.5 * n_cells).mean())
     assert cpp_frac > 0.3 and jax_frac > 0.3
     assert abs(cpp_frac - jax_frac) < 0.25, (cpp_frac, jax_frac)
+
+
+def test_multi_expert_cpp_finds_correct_expert():
+    """Native multi-expert loop: consensus picks the right expert and pose."""
+    frame = make_correspondence_frame(jax.random.key(7), noise=0.01)
+    n = frame["coords"].shape[0]
+    correct = 2
+    maps = np.stack([
+        np.asarray(frame["coords"]) if m == correct
+        else np.asarray(jax.random.uniform(jax.random.key(50 + m), (n, 3), maxval=5.0))
+        for m in range(4)
+    ])
+    from esac_tpu.backends import esac_infer_multi_cpp
+
+    out = esac_infer_multi_cpp(maps, np.asarray(frame["pixels"]), F, C,
+                               n_hyps_per_expert=128, seed=7)
+    assert out["expert"] == correct
+    assert out["expert_scores"].shape == (4,)
+    assert out["expert_scores"].argmax() == correct
+    r_err, t_err = pose_errors(
+        jnp.asarray(out["R"], jnp.float32), jnp.asarray(out["t"], jnp.float32),
+        rodrigues(frame["rvec"]), frame["tvec"],
+    )
+    assert r_err < 1.0 and t_err < 0.02
