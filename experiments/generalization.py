@@ -1,0 +1,72 @@
+import time, sys, jax; jax.config.update("jax_platforms","cpu")
+import jax.numpy as jnp, numpy as np, optax
+from esac_tpu.data import render_box_scene, random_poses_in_box
+from esac_tpu.data.augment import augment_frame
+from esac_tpu.models import ExpertNet
+from esac_tpu.train import make_expert_train_step
+from esac_tpu.ransac import RansacConfig, dsac_infer
+from esac_tpu.geometry import pose_errors, rodrigues
+
+H,W = 96,128; FOCAL=105.0; CENTER=(64.,48.)
+NET = dict(scene_center=(3.,2.,1.5), stem_channels=(16,32,64), head_channels=64, head_depth=2, compute_dtype=jnp.float32)
+n_frames, augment, iters = int(sys.argv[1]), sys.argv[2]=="aug", int(sys.argv[3])
+
+rv, tv = random_poses_in_box(jax.random.key(0), n_frames)
+render = jax.jit(jax.vmap(lambda r,t: render_box_scene(r,t,H,W,FOCAL,CENTER,8)))
+# render in chunks to bound memory
+imgs, crds = [], []
+for i in range(0, n_frames, 64):
+    o = render(rv[i:i+64], tv[i:i+64]); imgs.append(o["image"]); crds.append(o["coords_gt"])
+images = jnp.concatenate(imgs); coords = jnp.concatenate(crds).reshape(n_frames,12,16,3)
+pixels = render_box_scene(rv[0], tv[0], H,W,FOCAL,CENTER,8)["pixels"]
+
+net = ExpertNet(**NET); params = net.init(jax.random.key(1), images[:1])
+opt = optax.adam(optax.cosine_decay_schedule(1e-3, iters, 0.05)); os_ = opt.init(params)
+step = make_expert_train_step(net, opt)
+if augment:
+    fo = jnp.float32(FOCAL)
+    @jax.jit
+    def aug_batch(key, idx):
+        ks = jax.random.split(key, idx.shape[0])
+        out = jax.vmap(lambda k,im,co,r,t: augment_frame(k,im,co,r,t,fo))(ks, images[idx], coords[idx], rv[idx], tv[idx])
+        return out["image"], out["coords_gt"]
+rng = np.random.default_rng(2); akey = jax.random.key(3)
+masks = jnp.ones((8,12,16))
+t0=time.time()
+for it in range(iters):
+    idx = jnp.asarray(rng.integers(0, n_frames, 8))
+    if augment:
+        akey, sub = jax.random.split(akey)
+        im, co = aug_batch(sub, idx)
+    else:
+        im, co = images[idx], coords[idx]
+    params, os_, loss = step(params, os_, im, co, masks)
+# novel-view eval
+rv2, tv2 = random_poses_in_box(jax.random.key(100), 16)
+o = render(rv2, tv2)
+pred = net.apply(params, o["image"]).reshape(16,-1,3)
+gtc = o["coords_gt"].reshape(16,-1,3)
+coord_err = float(jnp.median(jnp.linalg.norm(pred-gtc, axis=-1)))
+cfg = RansacConfig(n_hyps=64, refine_iters=6)
+ok, rs, ts = 0, [], []
+for i in range(16):
+    out = dsac_infer(jax.random.key(200+i), pred[i], pixels, jnp.float32(FOCAL), jnp.asarray(CENTER), cfg)
+    r,t = pose_errors(rodrigues(out["rvec"]), out["tvec"], rodrigues(rv2[i]), tv2[i])
+    ok += int((r<5)&(t<0.05)); rs.append(float(r)); ts.append(float(t))
+print(f"frames={n_frames} aug={augment} iters={iters}: train_loss={float(loss):.3f} "
+      f"novel coord med={coord_err*100:.1f}cm pose med={np.median(rs):.2f}deg/{np.median(ts)*100:.1f}cm "
+      f"5cm5deg={ok}/16 ({time.time()-t0:.0f}s)")
+
+# Round-1 results (CPU, test-size net, 96x128 synthetic room, novel-view eval):
+#   frames=256  noaug iters=3000: coord med 3.2cm  pose med 4.17deg/ 9.8cm  2/16
+#   frames=1024 noaug iters=3000: coord med 2.8cm  pose med 3.29deg/ 8.7cm  4/16
+#   frames=1024 aug   iters=3000: coord med 3.8cm  pose med 3.17deg/ 8.3cm  2/16
+#   frames=1024 noaug iters=8000: coord med 1.4cm  pose med 1.78deg/ 5.2cm  8/16
+# Takeaways: (a) training iterations are the binding constraint — accuracy is
+# still compute-limited, not data- or augmentation-limited at this scale;
+# (b) pose error ~ 3-4x the median coordinate error (the expert's error field
+# is spatially correlated, so its low-frequency component aliases into the
+# pose and refinement cannot average it out); (c) augmentation at a fixed
+# budget slows fitting (use it for real-image appearance variation, not for
+# the noiseless synthetic scene). Ref-size nets + 10-100x iterations on TPU
+# are the round-2 recipe for the accuracy configs.
