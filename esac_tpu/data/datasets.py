@@ -38,7 +38,7 @@ from esac_tpu.data.synthetic import (
     random_poses_in_box,
     render_box_scene,
 )
-from esac_tpu.geometry.rotations import rodrigues, so3_log
+from esac_tpu.geometry.rotations import so3_log
 
 
 @dataclass

@@ -98,8 +98,7 @@ def reprojection_loss(
     The reference's depth-free init objective for outdoor scenes
     (SURVEY.md §0 stage 1).  pred: (N, 3) coords, pixels: (N, 2).
     """
-    from esac_tpu.geometry.camera import reprojection_errors
-    from esac_tpu.geometry.rotations import rodrigues  # noqa: F401  (kept local to avoid cycle)
+    from esac_tpu.geometry.camera import reprojection_errors  # local: avoids cycle
 
     errs = reprojection_errors(R_gt, t_gt, pred, pixels, f, c)
     return jnp.mean(jnp.minimum(errs, clamp_px))

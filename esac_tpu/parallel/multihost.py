@@ -1,0 +1,44 @@
+"""Multi-host initialization: extend the mesh across hosts (DCN axis).
+
+The reference is strictly single-process (SURVEY.md §2: no NCCL/MPI/Gloo);
+scaling this framework across hosts needs only standard JAX distributed
+bootstrap — the mesh abstraction and every collective in
+``esac_tpu.parallel`` are host-count agnostic.  Layout guidance: keep the
+``expert`` axis within a slice (its argmax all-reduce is latency-sensitive
+and should ride ICI) and put the ``data`` axis across slices (gradient
+pmeans tolerate DCN latency), which `make_mesh`'s (data, expert) ordering
+already encodes.
+
+Cannot be exercised in this single-host container; kept deliberately thin
+over `jax.distributed` so there is nothing here to rot.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> dict:
+    """Initialize JAX distributed (TPU pods auto-detect all arguments).
+
+    Call once per process before any other jax use.  Returns a summary dict
+    {'process_index', 'process_count', 'local_devices', 'global_devices'}.
+    """
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": jax.device_count(),
+    }

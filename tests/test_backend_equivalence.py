@@ -12,7 +12,7 @@ import numpy as np
 import pytest
 
 from esac_tpu.backends import cpp_available, esac_infer_cpp
-from esac_tpu.data import CAMERA_F, make_correspondence_frame
+from esac_tpu.data import make_correspondence_frame
 from esac_tpu.geometry import pose_errors, rodrigues
 from esac_tpu.ransac import RansacConfig, dsac_infer
 from esac_tpu.ransac.scoring import reprojection_error_map, soft_inlier_score

@@ -55,3 +55,20 @@ def test_cpp_backend_builds_under_tsan(tmp_path):
         pytest.skip(f"TSAN unavailable: {r.stderr[:200]}")
     assert r.returncode == 0, r.stderr
     assert lib.exists()
+
+
+def test_stage_timer_and_counter():
+    from esac_tpu.utils.profiling import StageTimer, hypotheses_per_sec
+
+    t = StageTimer()
+    x = jnp.ones(64)
+    with t("op") as hold:
+        hold.append(jnp.sum(x))
+    with t("op"):
+        pass
+    assert t.counts["op"] == 2 and t.totals["op"] > 0
+    assert "op" in t.summary()
+
+    fn = jax.jit(lambda: jnp.sum(jnp.ones(128)))
+    rate = hypotheses_per_sec(fn, (), n_hyps_per_call=128, repeats=3)
+    assert rate > 0

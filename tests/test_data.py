@@ -3,7 +3,6 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from esac_tpu.data import render_box_scene, random_poses_in_box
 from esac_tpu.data.augment import augment_frame

@@ -4,7 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from esac_tpu.data import CAMERA_F, make_correspondence_frame
 from esac_tpu.geometry import pose_errors, rodrigues

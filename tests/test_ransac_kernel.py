@@ -5,7 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from esac_tpu.data import CAMERA_C, CAMERA_F, make_correspondence_frame
+from esac_tpu.data import CAMERA_F, make_correspondence_frame
 from esac_tpu.geometry import pose_errors, rodrigues
 from esac_tpu.ransac import (
     RansacConfig,
