@@ -17,7 +17,7 @@ from esac_tpu.ransac.kernel import (
     generate_hypotheses,
     pose_loss,
 )
-from esac_tpu.ransac.esac import esac_infer, esac_train_loss
+from esac_tpu.ransac.esac import esac_infer, esac_infer_topk, esac_train_loss
 
 __all__ = [
     "RansacConfig",
@@ -29,6 +29,7 @@ __all__ = [
     "dsac_infer",
     "dsac_train_loss",
     "esac_infer",
+    "esac_infer_topk",
     "esac_train_loss",
     "pose_loss",
 ]

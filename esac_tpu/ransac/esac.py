@@ -123,6 +123,39 @@ def _expected_losses_per_expert(rvecs, tvecs, scores, coords_all, pixels, f, c, 
     return jax.vmap(one_expert)(rvecs, tvecs, scores, coords_all)
 
 
+@partial(jax.jit, static_argnames=("cfg", "k"))
+def esac_infer_topk(
+    key: jax.Array,
+    gating_logits: jnp.ndarray,
+    coords_all: jnp.ndarray,
+    pixels: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    cfg: RansacConfig = RansacConfig(),
+    k: int = 4,
+) -> dict:
+    """Inference with gating-pruned experts: only the top-k experts by gating
+    probability generate and score hypotheses.
+
+    The dense ``esac_infer`` is preferable for small M; for large ensembles
+    on a single chip (e.g. Aachen's ~50 clusters) this recovers the
+    reference's sparse-compute behavior with static shapes: a gather of k
+    coordinate maps instead of data-dependent expert sets.  A miss by the
+    gating net (true expert outside top-k) fails the frame, exactly as the
+    reference's drawn-subset policy can.
+    """
+    M = coords_all.shape[0]
+    k = min(k, M)
+    _, top = jax.lax.top_k(gating_logits, k)
+    coords_k = coords_all[top]  # (k, N, 3)
+    out = esac_infer(key, gating_logits[top], coords_k, pixels, f, c, cfg)
+    return {
+        **out,
+        "expert": top[out["expert"]],
+        "experts_evaluated": top,
+    }
+
+
 @partial(jax.jit, static_argnames=("cfg", "mode"))
 def esac_train_loss(
     key: jax.Array,

@@ -105,3 +105,35 @@ def test_gating_probs_reported():
     out = esac_infer(jax.random.key(9), logits, coords_all, frame["pixels"], F, C, CFG)
     assert out["gating_probs"].shape == (M,)
     assert float(out["gating_probs"][0]) > 0.8
+
+
+def test_topk_pruned_inference():
+    """Top-k gating pruning: correct result when the gate ranks the right
+    expert in the top k; the winner index maps back to the full ensemble."""
+    coords_all, frame = make_multi_expert_frame(jax.random.key(20), correct_expert=3)
+    from esac_tpu.ransac import esac_infer_topk
+
+    logits = jnp.array([0.0, 0.5, 0.2, 2.0])  # gate favors the right expert
+    out = esac_infer_topk(
+        jax.random.key(21), logits, coords_all, frame["pixels"], F, C, CFG, k=2
+    )
+    assert int(out["expert"]) == 3
+    assert out["experts_evaluated"].shape == (2,)
+    r_err, t_err = pose_errors(
+        rodrigues(out["rvec"]), out["tvec"], rodrigues(frame["rvec"]), frame["tvec"]
+    )
+    assert r_err < 5.0 and t_err < 0.05
+
+
+def test_topk_miss_behaves_like_reference():
+    """If the gate excludes the true expert from top-k, the frame fails —
+    the reference's drawn-subset failure mode, reported honestly."""
+    coords_all, frame = make_multi_expert_frame(jax.random.key(22), correct_expert=0)
+    from esac_tpu.ransac import esac_infer_topk
+
+    logits = jnp.array([-5.0, 2.0, 1.0, 0.5])  # gate wrongly buries expert 0
+    out = esac_infer_topk(
+        jax.random.key(23), logits, coords_all, frame["pixels"], F, C, CFG, k=2
+    )
+    assert int(out["expert"]) != 0
+    assert float(out["inlier_frac"]) < 0.3  # low consensus exposes the miss
