@@ -1,0 +1,122 @@
+"""Accuracy benchmark: novel-view 5cm/5deg on the synthetic scene, one JSON line.
+
+Complements bench.py (throughput) with the accuracy half of the acceptance
+criteria: trains an expert from scratch on the procedural room, evaluates
+localization on NOVEL views through the full pipeline, and prints
+
+  {"metric": "synthetic_novel_view_5cm5deg", "value": <fraction>,
+   "unit": "fraction", "vs_baseline": null, ...}
+
+Scale knobs (defaults are CPU-feasible; on a healthy TPU use --preset tpu for
+the reference-scale run):
+
+  python bench_accuracy.py                 # ~10 min CPU smoke point
+  python bench_accuracy.py --preset tpu    # ref-size net, 20k iters
+
+Round-1 scaling evidence lives in experiments/generalization.py: accuracy on
+this benchmark is iteration-limited, so the score primarily reflects the
+training budget — which is exactly what a round-over-round accuracy metric
+should track.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+PRESETS = {
+    # (frames, iters, net size, H, W)
+    "cpu": dict(frames=1024, iters=8000, size="test", height=96, width=128),
+    "tpu": dict(frames=4096, iters=20000, size="ref", height=192, width=256),
+}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--preset", choices=tuple(PRESETS), default="cpu")
+    p.add_argument("--cpu", action="store_true", help="force the CPU backend")
+    p.add_argument("--eval-frames", type=int, default=32)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from esac_tpu.data import random_poses_in_box, render_box_scene
+    from esac_tpu.cli import make_expert
+    from esac_tpu.geometry import pose_errors, rodrigues
+    from esac_tpu.ransac import RansacConfig, dsac_infer
+    from esac_tpu.train import make_expert_train_step
+
+    cfgp = PRESETS[args.preset]
+    H, W = cfgp["height"], cfgp["width"]
+    focal = 525.0 * W / 640.0
+    center = (W / 2.0, H / 2.0)
+    n_frames = cfgp["frames"]
+
+    t_start = time.time()
+    rv, tv = random_poses_in_box(jax.random.key(args.seed), n_frames)
+    render = jax.jit(
+        jax.vmap(lambda r, t: render_box_scene(r, t, H, W, focal, center, 8))
+    )
+    imgs, crds = [], []
+    for i in range(0, n_frames, 64):
+        out = render(rv[i:i + 64], tv[i:i + 64])
+        imgs.append(out["image"])
+        crds.append(out["coords_gt"])
+    images = jnp.concatenate(imgs)
+    coords = jnp.concatenate(crds).reshape(n_frames, H // 8, W // 8, 3)
+    pixels = render_box_scene(rv[0], tv[0], H, W, focal, center, 8)["pixels"]
+
+    net = make_expert(cfgp["size"], (3.0, 2.0, 1.5),
+                      dtype=jnp.float32 if args.cpu else None)
+    params = net.init(jax.random.key(args.seed + 1), images[:1])
+    opt = optax.adam(optax.cosine_decay_schedule(1e-3, cfgp["iters"], 0.05))
+    opt_state = opt.init(params)
+    step = make_expert_train_step(net, opt)
+    rng = np.random.default_rng(args.seed + 2)
+    masks = jnp.ones((8, H // 8, W // 8))
+    for _ in range(cfgp["iters"]):
+        idx = jnp.asarray(rng.integers(0, n_frames, 8))
+        params, opt_state, loss = step(params, opt_state, images[idx], coords[idx], masks)
+
+    rv2, tv2 = random_poses_in_box(jax.random.key(args.seed + 100), args.eval_frames)
+    evald = render(rv2[:64], tv2[:64])
+    pred = net.apply(params, evald["image"]).reshape(args.eval_frames, -1, 3)
+    cfg = RansacConfig(n_hyps=256)
+    ok, rot_errs, tr_errs = 0, [], []
+    infer = jax.jit(
+        lambda k, co: dsac_infer(k, co, pixels, jnp.float32(focal), jnp.asarray(center), cfg)
+    )
+    for i in range(args.eval_frames):
+        out = infer(jax.random.key(args.seed + 200 + i), pred[i])
+        r, t = pose_errors(
+            rodrigues(out["rvec"]), out["tvec"], rodrigues(rv2[i]), tv2[i]
+        )
+        ok += int((r < 5.0) & (t < 0.05))
+        rot_errs.append(float(r))
+        tr_errs.append(float(t))
+
+    print(json.dumps({
+        "metric": "synthetic_novel_view_5cm5deg",
+        "value": round(ok / args.eval_frames, 4),
+        "unit": "fraction",
+        "vs_baseline": None,
+        "median_rot_deg": round(float(np.median(rot_errs)), 3),
+        "median_trans_cm": round(100 * float(np.median(tr_errs)), 2),
+        "train_loss": round(float(loss), 4),
+        "preset": args.preset,
+        "wall_s": round(time.time() - t_start, 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,24 +36,37 @@ from esac_tpu.ransac.config import RansacConfig
 from esac_tpu.ransac.kernel import generate_hypotheses, pose_loss
 from esac_tpu.ransac.refine import refine_soft_inliers
 from esac_tpu.ransac.sampling import sample_expert_indices
-from esac_tpu.ransac.scoring import reprojection_error_map, soft_inlier_score
+from esac_tpu.ransac.scoring import (
+    reprojection_error_map,
+    soft_inlier_score,
+    subsample_cells,
+)
 
 
 def _per_expert_hypotheses(key, coords_all, pixels, f, c, cfg):
     """cfg.n_hyps hypotheses per expert. coords_all: (M, N, 3).
 
     Returns rvecs, tvecs (M, n_hyps, 3) and scores (M, n_hyps), each
-    hypothesis scored on its own expert's coordinate map.
+    hypothesis scored on its own expert's coordinate map (optionally on a
+    shared cell subsample, cfg.score_cells — the same cells for every expert
+    so cross-expert scores stay comparable).
     """
     M = coords_all.shape[0]
+    if cfg.score_cells:
+        key, k_sub = jax.random.split(key)
+    else:
+        k_sub = key
     keys = jax.random.split(key, M)
     rvecs, tvecs = jax.vmap(
         lambda k, co: generate_hypotheses(k, co, pixels, f, c, cfg)
     )(keys, coords_all)
-    errors = jax.vmap(
-        lambda rv, tv, co: reprojection_error_map(rv, tv, co, pixels, f, c)
-    )(rvecs, tvecs, coords_all)
-    scores = soft_inlier_score(errors, cfg.tau, cfg.beta)
+
+    def score_one(rv, tv, co):
+        co_s, px_s, scale = subsample_cells(k_sub, co, pixels, cfg.score_cells)
+        errors = reprojection_error_map(rv, tv, co_s, px_s, f, c)
+        return soft_inlier_score(errors, cfg.tau, cfg.beta) * scale
+
+    scores = jax.vmap(score_one)(rvecs, tvecs, coords_all)
     return rvecs, tvecs, scores
 
 

@@ -27,7 +27,18 @@ from esac_tpu.geometry.rotations import rodrigues
 from esac_tpu.ransac.config import RansacConfig
 from esac_tpu.ransac.refine import refine_soft_inliers
 from esac_tpu.ransac.sampling import sample_correspondence_sets
-from esac_tpu.ransac.scoring import reprojection_error_map, soft_inlier_score
+from esac_tpu.ransac.scoring import (
+    reprojection_error_map,
+    soft_inlier_score,
+    subsample_cells,
+)
+
+
+def _score_hypotheses(key, rvecs, tvecs, coords, pixels, f, c, cfg):
+    """Soft-inlier scores, optionally on a cell subsample (cfg.score_cells)."""
+    coords_s, pixels_s, scale = subsample_cells(key, coords, pixels, cfg.score_cells)
+    errors = reprojection_error_map(rvecs, tvecs, coords_s, pixels_s, f, c)
+    return soft_inlier_score(errors, cfg.tau, cfg.beta) * scale
 
 
 def generate_hypotheses(
@@ -83,9 +94,12 @@ def dsac_infer(
     Returns dict with 'rvec', 'tvec' (the refined winner), 'scores'
     (n_hyps,), 'best' (index), 'inlier_frac' of the winner.
     """
+    if cfg.score_cells:
+        key, k_sub = jax.random.split(key)
+    else:
+        k_sub = key
     rvecs, tvecs = generate_hypotheses(key, coords, pixels, f, c, cfg)
-    errors = reprojection_error_map(rvecs, tvecs, coords, pixels, f, c)
-    scores = soft_inlier_score(errors, cfg.tau, cfg.beta)
+    scores = _score_hypotheses(k_sub, rvecs, tvecs, coords, pixels, f, c, cfg)
     best = jnp.argmax(scores)
     rvec, tvec = refine_soft_inliers(
         rvecs[best],
@@ -134,9 +148,12 @@ def dsac_train_loss(
     Returns (loss, aux) where aux holds 'expected_loss', 'best_loss',
     'selection_probs', 'scores'.
     """
+    if cfg.score_cells:
+        key, k_sub = jax.random.split(key)
+    else:
+        k_sub = key
     rvecs, tvecs = generate_hypotheses(key, coords, pixels, f, c, cfg)
-    errors = reprojection_error_map(rvecs, tvecs, coords, pixels, f, c)
-    scores = soft_inlier_score(errors, cfg.tau, cfg.beta)
+    scores = _score_hypotheses(k_sub, rvecs, tvecs, coords, pixels, f, c, cfg)
     probs = jax.nn.softmax(cfg.alpha * scores)
 
     refine_one = lambda rv, tv: refine_soft_inliers(  # noqa: E731

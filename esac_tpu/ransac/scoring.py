@@ -45,6 +45,24 @@ def soft_inlier_score(
     return jnp.sum(jax.nn.sigmoid(beta * (tau - errors)), axis=-1)
 
 
+def subsample_cells(
+    key: jax.Array,
+    coords: jnp.ndarray,
+    pixels: jnp.ndarray,
+    n_sub: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, float]:
+    """Random cell subset for subsampled scoring (RansacConfig.score_cells).
+
+    Returns (coords_sub, pixels_sub, scale) with scale = N/n_sub so
+    subsampled soft-inlier counts stay comparable to full counts.
+    """
+    N = coords.shape[0]
+    if not n_sub or n_sub >= N:
+        return coords, pixels, 1.0
+    sub = jax.random.permutation(key, N)[:n_sub]
+    return coords[sub], pixels[sub], N / n_sub
+
+
 def soft_inlier_weights(
     errors: jnp.ndarray,
     tau: float,

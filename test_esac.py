@@ -37,6 +37,8 @@ def main(argv=None) -> int:
     p.add_argument("--gating", required=True)
     p.add_argument("--hypotheses", type=int, default=256)
     p.add_argument("--limit", type=int, default=0, help="max frames per scene (0 = all)")
+    p.add_argument("--topk", type=int, default=0,
+                   help="evaluate only the top-k gating experts (0 = all, dense)")
     args = p.parse_args(argv)
     maybe_force_cpu(args)
 
@@ -66,9 +68,18 @@ def main(argv=None) -> int:
         )
         return logits, coords.reshape(M, -1, 3)
 
-    infer_jax = jax.jit(
-        lambda k, lg, ca, focal: esac_infer(k, lg, ca, pixels, focal, cx, cfg)
-    )
+    if args.topk > 0:
+        from esac_tpu.ransac import esac_infer_topk
+
+        infer_jax = jax.jit(
+            lambda k, lg, ca, focal: esac_infer_topk(
+                k, lg, ca, pixels, focal, cx, cfg, k=args.topk
+            )
+        )
+    else:
+        infer_jax = jax.jit(
+            lambda k, lg, ca, focal: esac_infer(k, lg, ca, pixels, focal, cx, cfg)
+        )
 
     rot_errs, trans_errs, times, ok, expert_ok = [], [], [], 0, 0
     n_total = 0

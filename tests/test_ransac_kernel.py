@@ -167,3 +167,22 @@ def test_remat_matches_baseline_gradient():
     cos = a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12)
     assert cos > 0.99, cos
     assert np.isfinite(b).all()
+
+
+def test_subsampled_scoring_selects_good_pose():
+    """cfg.score_cells: selection on a 25% cell subsample must still find a
+    5cm/5deg pose (refinement uses all cells regardless)."""
+    frame = make_correspondence_frame(
+        jax.random.key(17), noise=0.01, outlier_frac=0.3, **FRAME_KW
+    )
+    n = frame["coords"].shape[0]
+    cfg = RansacConfig(n_hyps=64, refine_iters=4, score_cells=n // 4)
+    out = dsac_infer(jax.random.key(18), frame["coords"], frame["pixels"], F, SMALL_C, cfg)
+    r_err, t_err = pose_errors(
+        rodrigues(out["rvec"]), out["tvec"],
+        rodrigues(frame["rvec"]), frame["tvec"],
+    )
+    assert r_err < 5.0 and t_err < 0.05
+    # Scaled scores remain comparable to full counts.
+    assert float(out["scores"].max()) <= n * 1.05
+    assert float(out["inlier_frac"]) > 0.3
