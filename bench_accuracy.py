@@ -38,6 +38,8 @@ def main(argv=None) -> int:
     p.add_argument("--preset", choices=tuple(PRESETS), default="cpu")
     p.add_argument("--cpu", action="store_true", help="force the CPU backend")
     p.add_argument("--eval-frames", type=int, default=32)
+    p.add_argument("--iterations", type=int, default=0,
+                   help="override the preset's training iterations (dev)")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
 
@@ -55,7 +57,9 @@ def main(argv=None) -> int:
     from esac_tpu.ransac import RansacConfig, dsac_infer
     from esac_tpu.train import make_expert_train_step
 
-    cfgp = PRESETS[args.preset]
+    cfgp = dict(PRESETS[args.preset])
+    if args.iterations:
+        cfgp["iters"] = args.iterations
     H, W = cfgp["height"], cfgp["width"]
     focal = 525.0 * W / 640.0
     center = (W / 2.0, H / 2.0)
@@ -88,8 +92,12 @@ def main(argv=None) -> int:
         params, opt_state, loss = step(params, opt_state, images[idx], coords[idx], masks)
 
     rv2, tv2 = random_poses_in_box(jax.random.key(args.seed + 100), args.eval_frames)
-    evald = render(rv2[:64], tv2[:64])
-    pred = net.apply(params, evald["image"]).reshape(args.eval_frames, -1, 3)
+    eval_imgs = []
+    for i in range(0, args.eval_frames, 64):  # chunked like training renders
+        eval_imgs.append(render(rv2[i:i + 64], tv2[i:i + 64])["image"])
+    pred = net.apply(params, jnp.concatenate(eval_imgs)).reshape(
+        args.eval_frames, -1, 3
+    )
     cfg = RansacConfig(n_hyps=256)
     ok, rot_errs, tr_errs = 0, [], []
     infer = jax.jit(

@@ -33,14 +33,15 @@ import jax
 import jax.numpy as jnp
 
 from esac_tpu.ransac.config import RansacConfig
-from esac_tpu.ransac.kernel import generate_hypotheses, pose_loss
+from esac_tpu.ransac.kernel import (
+    _score_hypotheses,
+    _split_score_key,
+    generate_hypotheses,
+    pose_loss,
+)
 from esac_tpu.ransac.refine import refine_soft_inliers
 from esac_tpu.ransac.sampling import sample_expert_indices
-from esac_tpu.ransac.scoring import (
-    reprojection_error_map,
-    soft_inlier_score,
-    subsample_cells,
-)
+from esac_tpu.ransac.scoring import soft_inlier_score
 
 
 def _per_expert_hypotheses(key, coords_all, pixels, f, c, cfg):
@@ -52,21 +53,14 @@ def _per_expert_hypotheses(key, coords_all, pixels, f, c, cfg):
     so cross-expert scores stay comparable).
     """
     M = coords_all.shape[0]
-    if cfg.score_cells:
-        key, k_sub = jax.random.split(key)
-    else:
-        k_sub = key
+    key, k_sub = _split_score_key(key, cfg)
     keys = jax.random.split(key, M)
     rvecs, tvecs = jax.vmap(
         lambda k, co: generate_hypotheses(k, co, pixels, f, c, cfg)
     )(keys, coords_all)
-
-    def score_one(rv, tv, co):
-        co_s, px_s, scale = subsample_cells(k_sub, co, pixels, cfg.score_cells)
-        errors = reprojection_error_map(rv, tv, co_s, px_s, f, c)
-        return soft_inlier_score(errors, cfg.tau, cfg.beta) * scale
-
-    scores = jax.vmap(score_one)(rvecs, tvecs, coords_all)
+    scores = jax.vmap(
+        lambda rv, tv, co: _score_hypotheses(k_sub, rv, tv, co, pixels, f, c, cfg)
+    )(rvecs, tvecs, coords_all)
     return rvecs, tvecs, scores
 
 

@@ -35,10 +35,22 @@ from esac_tpu.ransac.scoring import (
 
 
 def _score_hypotheses(key, rvecs, tvecs, coords, pixels, f, c, cfg):
-    """Soft-inlier scores, optionally on a cell subsample (cfg.score_cells)."""
+    """Soft-inlier scores, optionally on a cell subsample (cfg.score_cells).
+
+    The single source of truth for hypothesis scoring — the ESAC multi-expert
+    path calls this too, so scale corrections stay in one place.
+    """
     coords_s, pixels_s, scale = subsample_cells(key, coords, pixels, cfg.score_cells)
     errors = reprojection_error_map(rvecs, tvecs, coords_s, pixels_s, f, c)
     return soft_inlier_score(errors, cfg.tau, cfg.beta) * scale
+
+
+def _split_score_key(key, cfg):
+    """(hypothesis key, scoring-subsample key); no split when not subsampling
+    so existing RNG streams stay bit-identical at score_cells=0."""
+    if cfg.score_cells:
+        return jax.random.split(key)
+    return key, key
 
 
 def generate_hypotheses(
@@ -94,10 +106,7 @@ def dsac_infer(
     Returns dict with 'rvec', 'tvec' (the refined winner), 'scores'
     (n_hyps,), 'best' (index), 'inlier_frac' of the winner.
     """
-    if cfg.score_cells:
-        key, k_sub = jax.random.split(key)
-    else:
-        k_sub = key
+    key, k_sub = _split_score_key(key, cfg)
     rvecs, tvecs = generate_hypotheses(key, coords, pixels, f, c, cfg)
     scores = _score_hypotheses(k_sub, rvecs, tvecs, coords, pixels, f, c, cfg)
     best = jnp.argmax(scores)
@@ -148,10 +157,7 @@ def dsac_train_loss(
     Returns (loss, aux) where aux holds 'expected_loss', 'best_loss',
     'selection_probs', 'scores'.
     """
-    if cfg.score_cells:
-        key, k_sub = jax.random.split(key)
-    else:
-        k_sub = key
+    key, k_sub = _split_score_key(key, cfg)
     rvecs, tvecs = generate_hypotheses(key, coords, pixels, f, c, cfg)
     scores = _score_hypotheses(k_sub, rvecs, tvecs, coords, pixels, f, c, cfg)
     probs = jax.nn.softmax(cfg.alpha * scores)

@@ -183,6 +183,8 @@ def test_subsampled_scoring_selects_good_pose():
         rodrigues(frame["rvec"]), frame["tvec"],
     )
     assert r_err < 5.0 and t_err < 0.05
-    # Scaled scores remain comparable to full counts.
-    assert float(out["scores"].max()) <= n * 1.05
+    # The N/n_sub scale must actually be applied: with ~70% inliers the
+    # winner's scaled count must exceed what an UNSCALED subsample could ever
+    # reach (n_sub = n/4), proving comparability with full counts.
+    assert float(out["scores"].max()) > n / 4
     assert float(out["inlier_frac"]) > 0.3
