@@ -137,3 +137,15 @@ def test_topk_miss_behaves_like_reference():
     )
     assert int(out["expert"]) != 0
     assert float(out["inlier_frac"]) < 0.3  # low consensus exposes the miss
+
+
+def test_esac_infer_with_subsampled_scoring():
+    coords_all, frame = make_multi_expert_frame(jax.random.key(30), correct_expert=1)
+    n = frame["coords"].shape[0]
+    cfg = RansacConfig(n_hyps=32, refine_iters=4, score_cells=n // 4)
+    out = esac_infer(jax.random.key(31), jnp.zeros(M), coords_all, frame["pixels"], F, C, cfg)
+    assert int(out["expert"]) == 1
+    r_err, t_err = pose_errors(
+        rodrigues(out["rvec"]), out["tvec"], rodrigues(frame["rvec"]), frame["tvec"]
+    )
+    assert r_err < 5.0 and t_err < 0.05

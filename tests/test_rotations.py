@@ -77,3 +77,28 @@ def test_vmap_jit_compose():
     rvecs = random_rvecs(jax.random.key(3), 16)
     R_vmapped = jax.jit(jax.vmap(rodrigues))(rvecs)
     np.testing.assert_allclose(R_vmapped, rodrigues(rvecs), atol=1e-6)
+
+
+def test_quaternion_to_matrix_identities():
+    from esac_tpu.geometry.rotations import quaternion_to_matrix
+
+    np.testing.assert_allclose(
+        quaternion_to_matrix(jnp.array([1.0, 0, 0, 0])), jnp.eye(3), atol=1e-6
+    )
+    # q and -q encode the same rotation.
+    q = jnp.array([0.3, -0.5, 0.2, 0.79])
+    np.testing.assert_allclose(
+        quaternion_to_matrix(q), quaternion_to_matrix(-q), atol=1e-6
+    )
+    # Unnormalized input is normalized defensively.
+    np.testing.assert_allclose(
+        quaternion_to_matrix(3.0 * q), quaternion_to_matrix(q), atol=1e-5
+    )
+    # Agreement with rodrigues on a known axis-angle.
+    import numpy as _np
+    angle = 0.8
+    axis = jnp.array([0.0, 1.0, 0.0])
+    qr = jnp.concatenate([jnp.array([_np.cos(angle / 2)]), _np.sin(angle / 2) * axis])
+    np.testing.assert_allclose(
+        quaternion_to_matrix(qr), rodrigues(axis * angle), atol=1e-5
+    )
