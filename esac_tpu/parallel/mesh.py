@@ -10,21 +10,30 @@ from __future__ import annotations
 
 import jax
 import numpy as np
-from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def make_mesh(n_data: int = 1, n_expert: int | None = None) -> Mesh:
-    """Build a ("data", "expert") mesh over the available devices."""
-    n_dev = jax.device_count()
+def make_mesh(
+    n_data: int = 1,
+    n_expert: int | None = None,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a ("data", "expert") mesh.
+
+    Uses all available devices by default; pass ``devices`` to build over a
+    subset (e.g. a dry run asked for fewer devices than the process has).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n_dev = len(devices)
     if n_expert is None:
         n_expert = n_dev // n_data
     if n_data * n_expert != n_dev:
         raise ValueError(
             f"mesh {n_data}x{n_expert} != device count {n_dev}"
         )
-    devices = mesh_utils.create_device_mesh((n_data, n_expert))
-    return Mesh(devices, axis_names=("data", "expert"))
+    dev_grid = np.asarray(devices, dtype=object).reshape(n_data, n_expert)
+    return Mesh(dev_grid, axis_names=("data", "expert"))
 
 
 def expert_sharding(mesh: Mesh) -> NamedSharding:
