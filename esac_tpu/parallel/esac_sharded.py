@@ -53,7 +53,7 @@ def esac_infer_sharded(
         shard_id = jax.lax.axis_index("expert")
         k_local = jax.random.fold_in(k, shard_id)
         rvecs, tvecs, scores = _per_expert_hypotheses(
-            k_local, coords_local, px, f, c, cfg
+            k_local, coords_local, px, f, c, cfg, inference=True
         )  # (m_local, nh, 3), (m_local, nh)
 
         # Local winner + full refinement (each device refines one pose).

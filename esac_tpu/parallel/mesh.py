@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import jax
 import numpy as np
+from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -23,16 +24,20 @@ def make_mesh(
     Uses all available devices by default; pass ``devices`` to build over a
     subset (e.g. a dry run asked for fewer devices than the process has).
     """
-    if devices is None:
-        devices = jax.devices()
-    n_dev = len(devices)
+    n_dev = len(devices) if devices is not None else jax.device_count()
     if n_expert is None:
         n_expert = n_dev // n_data
     if n_data * n_expert != n_dev:
         raise ValueError(
             f"mesh {n_data}x{n_expert} != device count {n_dev}"
         )
-    dev_grid = np.asarray(devices, dtype=object).reshape(n_data, n_expert)
+    if devices is None:
+        # Topology-aware ordering: on a real slice this maps mesh axes onto
+        # the ICI torus so the expert-axis collectives ride adjacent links.
+        dev_grid = mesh_utils.create_device_mesh((n_data, n_expert))
+    else:
+        # Explicit subset (dry runs): enumeration order is all we have.
+        dev_grid = np.asarray(devices, dtype=object).reshape(n_data, n_expert)
     return Mesh(dev_grid, axis_names=("data", "expert"))
 
 

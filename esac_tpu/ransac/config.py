@@ -41,6 +41,12 @@ class RansacConfig:
     # cell, so final pose quality is unaffected.  The reference scores all
     # cells; keep 0 for strict parity.
     score_cells: int = 0
+    # Use the fused Pallas scoring kernel (ransac/pallas_scoring.py) instead
+    # of the XLA error-map path.  Inference-path only (the kernel defines no
+    # VJP); falls back to interpret mode off-TPU.  Default off until
+    # validated on hardware (the TPU was unreachable when it was written —
+    # see CLAUDE.md); interpret-mode equivalence is tested.
+    use_pallas_scoring: bool = False
     # Rematerialize the per-hypothesis refinement in the backward pass
     # (jax.checkpoint): trades ~2x refine FLOPs for O(n_hyps * n_cells)
     # activation memory — needed for config-#5-scale training

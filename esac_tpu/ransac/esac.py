@@ -44,7 +44,7 @@ from esac_tpu.ransac.sampling import sample_expert_indices
 from esac_tpu.ransac.scoring import soft_inlier_score
 
 
-def _per_expert_hypotheses(key, coords_all, pixels, f, c, cfg):
+def _per_expert_hypotheses(key, coords_all, pixels, f, c, cfg, inference=False):
     """cfg.n_hyps hypotheses per expert. coords_all: (M, N, 3).
 
     Returns rvecs, tvecs (M, n_hyps, 3) and scores (M, n_hyps), each
@@ -59,7 +59,9 @@ def _per_expert_hypotheses(key, coords_all, pixels, f, c, cfg):
         lambda k, co: generate_hypotheses(k, co, pixels, f, c, cfg)
     )(keys, coords_all)
     scores = jax.vmap(
-        lambda rv, tv, co: _score_hypotheses(k_sub, rv, tv, co, pixels, f, c, cfg)
+        lambda rv, tv, co: _score_hypotheses(
+            k_sub, rv, tv, co, pixels, f, c, cfg, inference=inference
+        )
     )(rvecs, tvecs, coords_all)
     return rvecs, tvecs, scores
 
@@ -84,7 +86,9 @@ def esac_infer(
     Returns dict with 'rvec', 'tvec', 'expert' (winning expert index),
     'scores' (M, n_hyps), 'gating_probs'.
     """
-    rvecs, tvecs, scores = _per_expert_hypotheses(key, coords_all, pixels, f, c, cfg)
+    rvecs, tvecs, scores = _per_expert_hypotheses(
+        key, coords_all, pixels, f, c, cfg, inference=True
+    )
     M, nh = scores.shape
     flat = jnp.argmax(scores.reshape(-1))
     m_star, j_star = flat // nh, flat % nh

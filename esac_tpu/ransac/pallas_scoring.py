@@ -1,0 +1,156 @@
+"""Fused Pallas TPU kernel for soft-inlier scoring.
+
+The scoring stage — transform every cell by every hypothesis pose, project,
+take the pixel error, sigmoid, reduce — is the FLOP- and bandwidth-dominant
+stage of the pipeline once the minimal solves are optimized.  The XLA
+version materializes the (n_hyps, n_cells) error map in HBM between fusions;
+this kernel keeps everything in VMEM and writes only the (n_hyps,) scores.
+
+Layout (see /opt/skills/guides/pallas_guide.md):
+- hypotheses ride the sublane axis in blocks of 8 (f32 native tile height),
+  poses packed as 12 floats (row-major R | t) per hypothesis;
+- cells ride the lane axis in blocks of 512 (multiples of 128), coordinates
+  and pixels pre-transposed to (3, N) / (2, N);
+- the cell-block grid dimension is innermost and accumulates into the same
+  (8, 1) output block (TPU grids are sequential, so revisiting is safe);
+- the transform is done as broadcast outer products on the VPU — a (8, 512)
+  tile of Y per axis from (8, 1) pose columns x (1, 512) coordinate rows —
+  deliberately NOT an MXU matmul: K=3 contraction wastes the systolic array.
+
+Gated behind ``RansacConfig.use_pallas_scoring`` (default off) until
+validated on hardware; ``interpret=True`` runs the same kernel on CPU for
+the equivalence tests.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from esac_tpu.geometry.camera import MIN_DEPTH
+
+HYP_BLOCK = 8
+CELL_BLOCK = 512
+
+
+def _score_kernel(scal_ref, pose_ref, coords_ref, pixels_ref, out_ref):
+    """One (hyp-block, cell-block) tile of fused transform+project+score.
+
+    scal_ref: (5, 1) SMEM — f, cx, cy, tau, beta.
+    pose_ref: (HYP_BLOCK, 12) VMEM — rows [R00..R22, t0, t1, t2].
+    coords_ref: (3, CELL_BLOCK) VMEM;  pixels_ref: (2, CELL_BLOCK) VMEM.
+    out_ref: (HYP_BLOCK, 1) VMEM — accumulated over the cell grid dim.
+    """
+    f = scal_ref[0, 0]
+    cx = scal_ref[1, 0]
+    cy = scal_ref[2, 0]
+    tau = scal_ref[3, 0]
+    beta = scal_ref[4, 0]
+
+    X0 = coords_ref[0, :][None, :]  # (1, C)
+    X1 = coords_ref[1, :][None, :]
+    X2 = coords_ref[2, :][None, :]
+    px = pixels_ref[0, :][None, :]
+    py = pixels_ref[1, :][None, :]
+
+    def col(k):  # (H, 1) pose column
+        return pose_ref[:, k][:, None]
+
+    # Y = R X + t, broadcast (H,1) x (1,C) -> (H,C) per axis on the VPU.
+    Yx = col(0) * X0 + col(1) * X1 + col(2) * X2 + col(9)
+    Yy = col(3) * X0 + col(4) * X1 + col(5) * X2 + col(10)
+    Yz = col(6) * X0 + col(7) * X1 + col(8) * X2 + col(11)
+
+    z = jnp.maximum(Yz, MIN_DEPTH)
+    du = f * Yx / z + cx - px
+    dv = f * Yy / z + cy - py
+    err = jnp.sqrt(du * du + dv * dv + 1e-12)
+    err = jnp.where(Yz < MIN_DEPTH, err + 1000.0, err)
+    partial_scores = jnp.sum(
+        jax.nn.sigmoid(beta * (tau - err)), axis=1, keepdims=True
+    )  # (H, 1)
+
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[:] = partial_scores
+
+    @pl.when(j > 0)
+    def _acc():
+        out_ref[:] = out_ref[:] + partial_scores
+
+
+def _pad_to(x: jnp.ndarray, axis: int, multiple: int, value: float) -> jnp.ndarray:
+    n = x.shape[axis]
+    rem = (-n) % multiple
+    if rem == 0:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, rem)
+    return jnp.pad(x, pad, constant_values=value)
+
+
+@partial(jax.jit, static_argnames=("tau", "beta", "interpret"))
+def soft_inlier_scores_pallas(
+    Rs: jnp.ndarray,
+    ts: jnp.ndarray,
+    coords: jnp.ndarray,
+    pixels: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    tau: float,
+    beta: float,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused soft-inlier scores. Rs: (H, 3, 3), ts: (H, 3), coords: (N, 3),
+    pixels: (N, 2).  Returns (H,) float32 scores.
+
+    Padding cells are placed far behind the camera (err ~ 2000 px), so their
+    sigmoid contribution underflows to exactly 0 and no correction is needed;
+    padded hypotheses are sliced off the result.
+    """
+    H = Rs.shape[0]
+    poses = jnp.concatenate(
+        [Rs.reshape(H, 9), ts.reshape(H, 3)], axis=1
+    ).astype(jnp.float32)
+    poses = _pad_to(poses, 0, HYP_BLOCK, 0.0)
+
+    coords_t = coords.T.astype(jnp.float32)  # (3, N)
+    pixels_t = pixels.T.astype(jnp.float32)  # (2, N)
+    # Pad coordinates with a point far behind any camera: Y = R*X + t with
+    # X = 0 and identity-ish padding poses gives z = 0 < MIN_DEPTH -> the
+    # +1000 px branch -> sigmoid(beta*(tau - ~1000)) == 0 in f32.
+    coords_t = _pad_to(coords_t, 1, CELL_BLOCK, 0.0)
+    pixels_t = _pad_to(pixels_t, 1, CELL_BLOCK, 1e6)
+    Hp = poses.shape[0]
+    Np = coords_t.shape[1]
+
+    scalars = jnp.stack(
+        [jnp.float32(f), c[0].astype(jnp.float32), c[1].astype(jnp.float32),
+         jnp.float32(tau), jnp.float32(beta)]
+    ).reshape(5, 1)
+
+    grid = (Hp // HYP_BLOCK, Np // CELL_BLOCK)
+    out = pl.pallas_call(
+        _score_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((5, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((HYP_BLOCK, 12), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, CELL_BLOCK), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, CELL_BLOCK), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((HYP_BLOCK, 1), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((Hp, 1), jnp.float32),
+        interpret=interpret,
+    )(scalars, poses, coords_t, pixels_t)
+    return out[:H, 0]
