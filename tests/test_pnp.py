@@ -139,3 +139,40 @@ def test_degenerate_sample_gradient_is_finite():
 def test_so3_log_gradient_at_identity():
     g = jax.grad(lambda R: jnp.sum(so3_log(R)))(jnp.eye(3))
     assert jnp.all(jnp.isfinite(g))
+
+
+def test_gn_step_matches_jacfwd_step():
+    """The hand-derived left-perturbation Jacobian in _gn_pose_step must
+    produce the same LM step as an autodiff (jacfwd) reference build of the
+    same normal equations — a wrong-but-convergent Jacobian would otherwise
+    pass every convergence test."""
+    from esac_tpu.geometry.pnp import MIN_DEPTH, _gn_pose_step, _solve6_spd
+
+    rvec, t, X, x2d = make_problem(jax.random.key(30), n_points=24, noise_px=1.0)
+    R0 = rodrigues(rvec + 0.04)
+    t0 = t + jnp.array([0.03, -0.02, 0.05])
+    w = jax.random.uniform(jax.random.key(31), (24,), minval=0.2, maxval=1.0)
+    damping = 1e-4
+
+    R1, t1 = _gn_pose_step(R0, t0, X, x2d, F, C, w, damping)
+
+    # Reference: residuals r(delta, dt) = proj(exp(delta) R0 X + t0 + dt) - x2d
+    def residuals(p):
+        Rp = rodrigues(p[:3]) @ R0
+        Y = X @ Rp.T + t0 + p[3:]
+        z = jnp.maximum(Y[:, 2:3], MIN_DEPTH)
+        xp = Y[:, :2] / z * F + C
+        return (xp - x2d).reshape(-1)
+
+    J = jax.jacfwd(residuals)(jnp.zeros(6))  # (2N, 6)
+    r = residuals(jnp.zeros(6))
+    w2 = jnp.repeat(w, 2)
+    A = J.T @ (J * w2[:, None])
+    g = (J * w2[:, None]).T @ r
+    mu = damping * (jnp.trace(A) / 6.0 + 1e-6)
+    delta = _solve6_spd(A + mu * jnp.eye(6), g)
+    R_ref = rodrigues(-delta[:3]) @ R0
+    t_ref = t0 - delta[3:]
+
+    np.testing.assert_allclose(np.asarray(R1), np.asarray(R_ref), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(t1), np.asarray(t_ref), atol=2e-4)
