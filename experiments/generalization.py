@@ -70,3 +70,13 @@ print(f"frames={n_frames} aug={augment} iters={iters}: train_loss={float(loss):.
 # budget slows fitting (use it for real-image appearance variation, not for
 # the noiseless synthetic scene). Ref-size nets + 10-100x iterations on TPU
 # are the round-2 recipe for the accuracy configs.
+#
+# Stage-3 selection-temperature (alpha) sweep, same setting via the CLI
+# (2 scenes, test-size nets, 200 e2e iters, novel-view test split; pre-stage-3
+# baseline = 6.2% 5cm/5deg, median 5.22deg/12.3cm):
+#   alpha=0.05: 4.2%  (5.76deg/15.1cm)  -- too-soft selection HURTS
+#   alpha=0.1 : 12.5% (5.45deg/14.0cm)
+#   alpha=0.5 : 12.5% (5.06deg/12.5cm)  <- best: same rate, best medians
+# Recommendation for reference-scale stage 3: start at alpha=0.5 (sharp,
+# near-argmax selection); soft selection dilutes the gradient across
+# hypotheses that refinement cannot rescue.

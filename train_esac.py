@@ -40,6 +40,10 @@ def main(argv=None) -> int:
     p.add_argument("--gating", required=True, help="stage-2 gating checkpoint")
     p.add_argument("--hypotheses", type=int, default=256)
     p.add_argument("--estimator", choices=("dense", "sampled"), default="dense")
+    p.add_argument("--alpha", type=float, default=0.1,
+                   help="softmax selection temperature over hypothesis scores")
+    p.add_argument("--loss-clamp", type=float, default=100.0,
+                   help="per-hypothesis pose-loss clamp (deg-equivalent)")
     p.add_argument("--output", default="ckpt_esac")
     args = p.parse_args(argv)
     maybe_force_cpu(args)
@@ -64,7 +68,8 @@ def main(argv=None) -> int:
     H, W = f0.image.shape[:2]
     stride = 8
     pixels = output_pixel_grid(H, W, stride)
-    cfg = RansacConfig(n_hyps=args.hypotheses, train_refine_iters=1)
+    cfg = RansacConfig(n_hyps=args.hypotheses, train_refine_iters=1,
+                       alpha=args.alpha, loss_clamp=args.loss_clamp)
     cx = jnp.asarray([W / 2.0, H / 2.0])
 
     opt = optax.adam(args.learningrate)
