@@ -80,3 +80,8 @@ print(f"frames={n_frames} aug={augment} iters={iters}: train_loss={float(loss):.
 # Recommendation for reference-scale stage 3: start at alpha=0.5 (sharp,
 # near-argmax selection); soft selection dilutes the gradient across
 # hypotheses that refinement cannot rescue.
+#
+# Estimator parity at the same setting (alpha=0.5, 200 e2e iters): the
+# sampled/REINFORCE estimator (reference parity) reaches 12.5% 5cm/5deg,
+# 5.17deg/11.8cm median — statistically identical to dense. Both gradient
+# estimators are healthy end-to-end through the CLI.
