@@ -149,3 +149,24 @@ def test_esac_infer_with_subsampled_scoring():
         rodrigues(out["rvec"]), out["tvec"], rodrigues(frame["rvec"]), frame["tvec"]
     )
     assert r_err < 5.0 and t_err < 0.05
+
+
+def test_config3_shape_twelve_experts_1024_hyps():
+    """BASELINE config #3 structure: 12 experts, 1024 hypotheses vmap'd —
+    must compile and localize on the test mesh (reduced cells for CPU CI)."""
+    frame = make_correspondence_frame(jax.random.key(40), noise=0.01, **FRAME_KW)
+    n = frame["coords"].shape[0]
+    correct = 7
+    maps = jnp.stack([
+        frame["coords"] if m == correct
+        else jax.random.uniform(jax.random.fold_in(jax.random.key(41), m), (n, 3), maxval=5.0)
+        for m in range(12)
+    ])
+    cfg = RansacConfig(n_hyps=1024, refine_iters=4, score_cells=n // 2)
+    out = esac_infer(jax.random.key(42), jnp.zeros(12), maps, frame["pixels"], F, C, cfg)
+    assert out["scores"].shape == (12, 1024)
+    assert int(out["expert"]) == correct
+    r_err, t_err = pose_errors(
+        rodrigues(out["rvec"]), out["tvec"], rodrigues(frame["rvec"]), frame["tvec"]
+    )
+    assert r_err < 5.0 and t_err < 0.05

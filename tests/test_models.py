@@ -129,3 +129,27 @@ def test_torch_converter_rejects_shape_mismatch():
     bad = {"fc.weight": torch.zeros(4, 99), "fc.bias": torch.zeros(4)}
     with pytest.raises(ValueError, match="shape mismatch"):
         torch_state_dict_to_flax(bad, params["params"])
+
+
+def test_convert_checkpoint_cli_gating(tmp_path):
+    torch = pytest.importorskip("torch")
+    import subprocess, sys, pathlib
+
+    REPO = pathlib.Path(__file__).resolve().parent.parent
+    # Torch twin of GatingNet(size=test, experts=3): convs (8,16) x2 + 2 dense.
+    layers = [
+        torch.nn.Conv2d(3, 8, 3, stride=2, padding=1), torch.nn.Conv2d(8, 8, 3, padding=1),
+        torch.nn.Conv2d(8, 16, 3, stride=2, padding=1), torch.nn.Conv2d(16, 16, 3, padding=1),
+        torch.nn.Linear(16, 64), torch.nn.Linear(64, 3),
+    ]
+    sd = torch.nn.Sequential(*layers).state_dict()
+    pth = tmp_path / "g.pth"
+    torch.save(sd, pth)
+    r = subprocess.run(
+        [sys.executable, str(REPO / "convert_checkpoint.py"), "gating", str(pth),
+         str(tmp_path / "out"), "--size", "test", "--experts", "3",
+         "--height", "64", "--width", "64"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr
+    assert (tmp_path / "out" / "config.json").exists()
