@@ -352,6 +352,13 @@ int esac_cpp_infer(const float* coords, const float* pixels, int n_cells,
                    float f, float cx, float cy, int n_hyps, float tau,
                    float beta, int refine_iters, uint64_t seed, double* out_R,
                    double* out_t, double* out_score, double* out_scores) {
+  // Fewer cells than a minimal set: the distinct-index rejection loop below
+  // could never terminate, so fail the frame up front.
+  if (n_cells < 4) {
+    if (out_scores)
+      for (int h = 0; h < n_hyps; h++) out_scores[h] = -1.0;
+    return 0;
+  }
   int n_valid = 0;
   double best_score = -1.0;
   double best_R[9], best_t[3];

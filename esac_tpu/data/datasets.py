@@ -132,9 +132,13 @@ class SyntheticScene:
 
     def __init__(self, scene: str = "synth0", split: str = "training",
                  n_frames: int = 64, height: int = 96, width: int = 128,
-                 coord_stride: int = 8):
+                 coord_stride: int = 8, expert: int | None = None):
         sid = int(scene.replace("synth", "") or 0)
         self.sid = sid
+        # Expert label is the caller's position in its scene list, NOT the
+        # scene-name suffix: 'synth2 synth5' with M=2 must label frames 0/1,
+        # or gating cross-entropy trains on out-of-range classes.
+        self.expert = sid if expert is None else expert
         self.height, self.width, self.stride = height, width, coord_stride
         self.focal = CAMERA_F * width / 640.0
         seed = sid * 1000 + (0 if split == "training" else 1)
@@ -171,15 +175,19 @@ class SyntheticScene:
             self._tvecs[i],
             self.focal,
             self._coords[i],
-            self.sid,
+            self.expert,
         )
 
 
-def open_scene(root: str, scene: str, split: str, expert: int = 0, **kw):
-    """Dispatch: ``synthN`` -> SyntheticScene, else on-disk SceneDataset."""
+def open_scene(root: str, scene: str, split: str, expert: int | None = None, **kw):
+    """Dispatch: ``synthN`` -> SyntheticScene, else on-disk SceneDataset.
+
+    ``expert=None`` keeps each class's own default label (sid for synthetic
+    scenes, 0 on disk), matching direct construction.
+    """
     if scene.startswith("synth"):
-        return SyntheticScene(scene, split, **kw)
-    return SceneDataset(root, scene, split, expert=expert)
+        return SyntheticScene(scene, split, expert=expert, **kw)
+    return SceneDataset(root, scene, split, expert=expert or 0, **kw)
 
 
 def batch_frames(ds, idx: np.ndarray) -> dict:

@@ -19,6 +19,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from esac_tpu.ransac.config import RansacConfig
 from esac_tpu.ransac.esac import _per_expert_hypotheses
+from esac_tpu.ransac.kernel import _split_score_key
 from esac_tpu.ransac.refine import refine_soft_inliers
 
 
@@ -48,12 +49,16 @@ def esac_infer_sharded(
         out_specs=(P(), P(), P(), P()),
     )
     def body(k, coords_local, px):
-        # Every shard derives its own key from its expert-shard position so
-        # hypothesis draws differ across shards deterministically.
+        # Split the scoring-subsample key BEFORE the per-shard fold_in: the
+        # cross-shard argmax compares soft-inlier scores, which are only
+        # comparable if every shard scores on the same random cell subset.
+        # Only the hypothesis key differs per shard.
         shard_id = jax.lax.axis_index("expert")
-        k_local = jax.random.fold_in(k, shard_id)
+        k_hyp, k_sub = _split_score_key(k, cfg)
+        k_local = jax.random.fold_in(k_hyp, shard_id)
         rvecs, tvecs, scores = _per_expert_hypotheses(
-            k_local, coords_local, px, f, c, cfg, inference=True
+            k_local, coords_local, px, f, c, cfg, inference=True,
+            score_key=k_sub,
         )  # (m_local, nh, 3), (m_local, nh)
 
         # Local winner + full refinement (each device refines one pose).

@@ -44,16 +44,22 @@ from esac_tpu.ransac.sampling import sample_expert_indices
 from esac_tpu.ransac.scoring import soft_inlier_score
 
 
-def _per_expert_hypotheses(key, coords_all, pixels, f, c, cfg, inference=False):
+def _per_expert_hypotheses(key, coords_all, pixels, f, c, cfg, inference=False,
+                           score_key=None):
     """cfg.n_hyps hypotheses per expert. coords_all: (M, N, 3).
 
     Returns rvecs, tvecs (M, n_hyps, 3) and scores (M, n_hyps), each
     hypothesis scored on its own expert's coordinate map (optionally on a
     shared cell subsample, cfg.score_cells — the same cells for every expert
-    so cross-expert scores stay comparable).
+    so cross-expert scores stay comparable).  Expert-sharded callers must
+    pass a replicated ``score_key`` so the shared-cells invariant holds
+    *across shards* too (their ``key`` is already folded per shard).
     """
     M = coords_all.shape[0]
-    key, k_sub = _split_score_key(key, cfg)
+    if score_key is None:
+        key, k_sub = _split_score_key(key, cfg)
+    else:
+        k_sub = score_key
     keys = jax.random.split(key, M)
     rvecs, tvecs = jax.vmap(
         lambda k, co: generate_hypotheses(k, co, pixels, f, c, cfg)
@@ -164,6 +170,10 @@ def esac_infer_topk(
         **out,
         "expert": top[out["expert"]],
         "experts_evaluated": top,
+        # Full M-way distribution, matching esac_infer — NOT renormalized
+        # over the pruned subset.  Note 'scores' stays (k, n_hyps): rows
+        # align with 'experts_evaluated', not with expert index.
+        "gating_probs": jax.nn.softmax(gating_logits),
     }
 
 

@@ -109,3 +109,16 @@ def test_multi_expert_cpp_finds_correct_expert():
         rodrigues(frame["rvec"]), frame["tvec"],
     )
     assert r_err < 1.0 and t_err < 0.02
+
+
+def test_cpp_rejects_degenerate_cell_count():
+    """ADVICE r1: n_cells < 4 used to spin forever in the distinct-index
+    rejection loop; it must fail the frame immediately instead."""
+    if not cpp_available():
+        pytest.skip("cpp backend unavailable")
+    coords = np.zeros((3, 3), dtype=np.float32)
+    pixels = np.zeros((3, 2), dtype=np.float32)
+    out = esac_infer_cpp(coords, pixels, 500.0, (80.0, 60.0), n_hyps=8,
+                         return_scores=True)
+    assert out["n_valid"] == 0
+    assert (out["scores"] == -1.0).all()

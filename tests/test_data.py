@@ -111,3 +111,20 @@ def test_batch_frames_shapes():
     assert b["images"].shape == (3, 96, 128, 3)
     assert b["coords_gt"].shape == (3, 12, 16, 3)
     assert b["labels"].shape == (3,)
+
+
+def test_open_scene_noncontiguous_synth_labels_by_position():
+    """ADVICE r1 (medium): 'synth2 synth5' with M=2 must label frames 0/1 —
+    the caller's position in its scene list — not the scene-name suffix,
+    or gating cross-entropy trains on out-of-range classes."""
+    scenes = ["synth2", "synth5"]
+    dsets = [
+        open_scene("unused", s, "training", expert=i, n_frames=2)
+        for i, s in enumerate(scenes)
+    ]
+    labels = [ds[0].expert for ds in dsets]
+    assert labels == [0, 1]
+    b = batch_frames(dsets[1], np.array([0, 1]))
+    assert int(b["labels"].max()) < len(scenes)
+    # Direct construction without an expert override keeps the sid label.
+    assert SyntheticScene("synth3", n_frames=2)[0].expert == 3

@@ -170,3 +170,20 @@ def test_config3_shape_twelve_experts_1024_hyps():
         rodrigues(out["rvec"]), out["tvec"], rodrigues(frame["rvec"]), frame["tvec"]
     )
     assert r_err < 5.0 and t_err < 0.05
+
+
+def test_topk_gating_probs_full_distribution():
+    """ADVICE r1: esac_infer_topk must report the full M-way softmax like
+    esac_infer, not a renormalization over the k pruned experts."""
+    coords_all, frame = make_multi_expert_frame(jax.random.key(9), correct_expert=1)
+    logits = jnp.array([2.0, 1.0, 0.0, -1.0])
+    from esac_tpu.ransac import esac_infer_topk
+
+    out = esac_infer_topk(
+        jax.random.key(1), logits, coords_all, frame["pixels"], F, C, CFG, k=2
+    )
+    np.testing.assert_allclose(
+        np.asarray(out["gating_probs"]), np.asarray(jax.nn.softmax(logits)),
+        rtol=1e-6,
+    )
+    assert out["scores"].shape == (2, CFG.n_hyps)

@@ -145,3 +145,33 @@ def test_graft_entry_compiles_and_runs():
     rvec, tvec, expert = out
     assert rvec.shape == (3,) and tvec.shape == (3,)
     assert jnp.all(jnp.isfinite(rvec)) and jnp.all(jnp.isfinite(tvec))
+
+
+def test_sharded_subsampled_scoring_uses_shared_cells():
+    """ADVICE r1: with cfg.score_cells the cross-shard argmax must compare
+    scores computed on ONE replicated cell subset.  Pin the key-derivation
+    contract by replicating the sharded algorithm on a single device with the
+    same split-before-fold keys and requiring an exact winner/score match."""
+    from esac_tpu.ransac.esac import _per_expert_hypotheses
+    from esac_tpu.ransac.kernel import _split_score_key
+
+    cfg = RansacConfig(n_hyps=32, refine_iters=2, score_cells=64)
+    mesh = make_mesh(n_data=1, n_expert=8)
+    coords_all, frame = make_expert_maps(jax.random.key(9), 8, correct=4)
+    key = jax.random.key(11)
+    rvec, tvec, expert, score = esac_infer_sharded(
+        mesh, key, jax.device_put(coords_all, expert_sharding(mesh)),
+        frame["pixels"], F, C, cfg,
+    )
+
+    k_hyp, k_sub = _split_score_key(key, cfg)
+    best_scores = []
+    for sid in range(8):
+        k_local = jax.random.fold_in(k_hyp, sid)
+        _, _, sc = _per_expert_hypotheses(
+            k_local, coords_all[sid:sid + 1], frame["pixels"], F, C, cfg,
+            inference=True, score_key=k_sub,
+        )
+        best_scores.append(float(jnp.max(sc)))
+    assert int(expert) == int(np.argmax(best_scores)) == 4
+    np.testing.assert_allclose(float(score), max(best_scores), rtol=1e-5)

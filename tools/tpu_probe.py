@@ -1,0 +1,64 @@
+"""Cautious TPU relay liveness probe (wedge-safe by construction).
+
+Launch pattern (the ONLY sanctioned way to touch the chip, per CLAUDE.md):
+
+    setsid nohup python tools/tpu_probe.py > .tpu_probe.log 2>&1 &
+
+The process is orphaned at launch and must NEVER be killed or timed out —
+killing a jax process holding/awaiting the device wedges the relay
+permanently.  Progress is reported via an incrementally updated JSON file
+(.tpu_probe.json) so a watcher can observe phase-by-phase how far the probe
+got without touching the process:
+
+    phase: "started" -> "importing" -> "backend_init" -> "compute" -> "ok"
+
+If the file stops advancing at "backend_init", the relay is wedged (backend
+init blocks forever); the probe process is left to hang harmlessly and the
+round proceeds on CPU fallbacks.  No other TPU process may be launched while
+a probe is unresolved.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+RESULT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".tpu_probe.json")
+
+
+def report(phase: str, **extra) -> None:
+    payload = {"phase": phase, "t": time.time(), "pid": os.getpid(), **extra}
+    tmp = RESULT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, RESULT)
+
+
+def main() -> None:
+    t0 = time.time()
+    report("started")
+    report("importing")
+    import jax  # noqa: E402
+
+    report("backend_init")
+    devs = jax.devices()  # blocks forever if the relay is wedged
+    kind = devs[0].device_kind if devs else "none"
+    report("compute", device_kind=kind, n_devices=len(devs))
+    import jax.numpy as jnp
+
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    y = (x @ x).sum()
+    jax.block_until_ready(y)
+    report(
+        "ok",
+        device_kind=kind,
+        n_devices=len(devs),
+        platform=devs[0].platform,
+        elapsed_s=round(time.time() - t0, 2),
+        matmul_sum=float(y),
+    )
+
+
+if __name__ == "__main__":
+    main()
