@@ -21,7 +21,9 @@ class RansacConfig:
     # Sigmoid sharpness of the soft-inlier count: sigmoid(beta * (tau - r)).
     beta: float = 0.5
     # Softmax temperature over scores for hypothesis selection in training.
-    alpha: float = 0.1
+    # 0.5 per the round-1 alpha sweep (experiments/generalization.py): sharp
+    # selection trains best; 0.05 actively hurts.
+    alpha: float = 0.5
     # IRLS (re-weighted Gauss-Newton) rounds when refining the winning pose.
     refine_iters: int = 8
     # Light per-hypothesis refinement rounds inside the training expectation.

@@ -17,6 +17,8 @@ import json
 import pathlib
 from typing import Any
 
+import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 
@@ -29,8 +31,16 @@ def save_checkpoint(path: str | pathlib.Path, params: Any, config: dict) -> None
 
 
 def load_checkpoint(path: str | pathlib.Path) -> tuple[Any, dict]:
+    """Restore as HOST numpy arrays: checkpoints written on one topology
+    (e.g. the TPU) must load on any other (e.g. the CPU test mesh) — the
+    saved device shardings are a property of the writer, not the data.
+    Callers hand the tree to jit, which places it."""
     path = pathlib.Path(path).absolute()
     with ocp.PyTreeCheckpointer() as ckptr:
-        params = ckptr.restore(path / "params")
+        tree = ckptr.metadata(path / "params").item_metadata.tree
+        restore_args = jax.tree.map(
+            lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree
+        )
+        params = ckptr.restore(path / "params", restore_args=restore_args)
     config = json.loads((path / "config.json").read_text())
     return params, config

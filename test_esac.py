@@ -39,6 +39,11 @@ def main(argv=None) -> int:
     p.add_argument("--limit", type=int, default=0, help="max frames per scene (0 = all)")
     p.add_argument("--topk", type=int, default=0,
                    help="evaluate only the top-k gating experts (0 = all, dense)")
+    p.add_argument("--eval-batch", type=int, default=16,
+                   help="frames per jitted dispatch; evaluation is O(batches) "
+                        "device round-trips, not O(frames) — the per-dispatch "
+                        "relay latency of this environment makes per-frame "
+                        "dispatch the dominant cost otherwise")
     args = p.parse_args(argv)
     maybe_force_cpu(args)
 
@@ -46,11 +51,19 @@ def main(argv=None) -> int:
         open_scene(args.root, s, "test", expert=i) for i, s in enumerate(args.scenes)
     ]
     M = len(datasets)
-    e_params, e_nets = [], []
+    e_params, e_cfgs = [], []
     for ck in args.experts:
         params, cfg_d = load_checkpoint(ck)
         e_params.append(params)
-        e_nets.append(make_expert(cfg_d["size"], cfg_d["scene_center"]))
+        e_cfgs.append(cfg_d)
+    sizes = {d["size"] for d in e_cfgs}
+    if len(sizes) != 1:
+        p.error(f"experts must share one size preset, got {sorted(sizes)}")
+    e_net = make_expert(sizes.pop(), (0.0, 0.0, 0.0))
+    e_stack = jax.tree.map(lambda *xs: jnp.stack(xs), *e_params)
+    e_centers = jnp.stack(
+        [jnp.asarray(d["scene_center"], jnp.float32) for d in e_cfgs]
+    )
     g_params, g_cfg = load_checkpoint(args.gating)
     gating = make_gating(g_cfg["size"], M)
 
@@ -61,63 +74,82 @@ def main(argv=None) -> int:
     cfg = RansacConfig(n_hyps=args.hypotheses)
 
     @jax.jit
-    def predict_coords(image):
-        logits = gating.apply(g_params, image[None])[0]
-        coords = jnp.stack(
-            [e_nets[m].apply(e_params[m], image[None])[0] for m in range(M)]
+    def predict_coords(images):
+        """(B, H, W, 3) -> gating logits (B, M) and coord maps (B, M, cells, 3)."""
+        logits = gating.apply(g_params, images)
+        coords = jax.lax.map(
+            lambda pc: e_net.apply(pc[0], images) + pc[1], (e_stack, e_centers)
+        )  # (M, B, h, w, 3)
+        return logits, jnp.moveaxis(coords, 0, 1).reshape(
+            images.shape[0], M, -1, 3
         )
-        return logits, coords.reshape(M, -1, 3)
 
     if args.topk > 0:
         from esac_tpu.ransac import esac_infer_topk
 
-        infer_jax = jax.jit(
-            lambda k, lg, ca, focal: esac_infer_topk(
-                k, lg, ca, pixels, focal, cx, cfg, k=args.topk
-            )
+        one = lambda k, lg, ca, focal: esac_infer_topk(  # noqa: E731
+            k, lg, ca, pixels, focal, cx, cfg, k=args.topk
         )
     else:
-        infer_jax = jax.jit(
-            lambda k, lg, ca, focal: esac_infer(k, lg, ca, pixels, focal, cx, cfg)
+        one = lambda k, lg, ca, focal: esac_infer(  # noqa: E731
+            k, lg, ca, pixels, focal, cx, cfg
         )
+    infer_jax = jax.jit(jax.vmap(one))
 
-    rot_errs, trans_errs, times, ok, expert_ok = [], [], [], 0, 0
-    n_total = 0
+    # Stage all frames host-side, then evaluate in fixed-size batches: one
+    # dispatch per batch for the networks and one for the hypothesis loop.
+    frames = []
     for ds in datasets:
         n = len(ds) if args.limit == 0 else min(args.limit, len(ds))
-        for i in range(n):
-            fr = ds[i]
-            image = jnp.asarray(fr.image)
-            focal = jnp.float32(fr.focal)
-            logits, coords_all = predict_coords(image)
-            jax.block_until_ready(coords_all)
-            t0 = time.perf_counter()
-            if args.backend == "jax":
-                out = infer_jax(jax.random.key(n_total), logits, coords_all, focal)
-                rvec, tvec = out["rvec"], out["tvec"]
-                jax.block_until_ready(rvec)
-                expert = int(out["expert"])
-                R_est = rodrigues(rvec)
-            else:
-                from esac_tpu.backends import esac_infer_multi_cpp
+        frames.extend(ds[i] for i in range(n))
+    n_total = len(frames)
+    images_h = np.stack([f.image for f in frames])
+    focals_h = np.asarray([f.focal for f in frames], np.float32)
+    labels_h = np.asarray([f.expert for f in frames])
+    R_gts = jax.vmap(rodrigues)(jnp.asarray(np.stack([f.rvec for f in frames])))
+    t_gts = jnp.asarray(np.stack([f.tvec for f in frames]))
 
+    rot_errs, trans_errs, times, ok, expert_ok = [], [], [], 0, 0
+    B = max(1, args.eval_batch)
+    for start in range(0, n_total, B):
+        sel = np.arange(start, min(start + B, n_total))
+        pad = np.pad(sel, (0, B - len(sel)), mode="edge")  # static batch shape
+        images = jnp.asarray(images_h[pad])
+        focals = jnp.asarray(focals_h[pad])
+        logits, coords_all = predict_coords(images)
+        jax.block_until_ready(coords_all)
+        t0 = time.perf_counter()
+        if args.backend == "jax":
+            keys = jax.vmap(jax.random.key)(jnp.asarray(pad))
+            out = infer_jax(keys, logits, coords_all, focals)
+            jax.block_until_ready(out["rvec"])
+            dt = (time.perf_counter() - t0) / len(pad)
+            R_b = jax.vmap(rodrigues)(out["rvec"])
+            t_b = out["tvec"]
+            experts = np.asarray(out["expert"])
+        else:
+            from esac_tpu.backends import esac_infer_multi_cpp
+
+            co_np, px_np = np.asarray(coords_all), np.asarray(pixels)
+            Rs, ts, experts = [], [], []
+            for j, gi in enumerate(pad):
                 r = esac_infer_multi_cpp(
-                    np.asarray(coords_all), np.asarray(pixels),
-                    float(focal), (W / 2.0, H / 2.0),
-                    n_hyps_per_expert=args.hypotheses, seed=n_total,
+                    co_np[j], px_np, float(focals_h[gi]), (W / 2.0, H / 2.0),
+                    n_hyps_per_expert=args.hypotheses, seed=int(gi),
                 )
-                expert = r["expert"]
-                R_est = jnp.asarray(r["R"], jnp.float32)
-                tvec = jnp.asarray(r["t"], jnp.float32)
-            times.append(time.perf_counter() - t0)
-            r_err, t_err = pose_errors(
-                R_est, tvec, rodrigues(jnp.asarray(fr.rvec)), jnp.asarray(fr.tvec)
-            )
-            rot_errs.append(float(r_err))
-            trans_errs.append(float(t_err))
+                Rs.append(r["R"]); ts.append(r["t"]); experts.append(r["expert"])
+            dt = (time.perf_counter() - t0) / len(pad)
+            R_b = jnp.asarray(np.stack(Rs), jnp.float32)
+            t_b = jnp.asarray(np.stack(ts), jnp.float32)
+            experts = np.asarray(experts)
+        r_errs, t_errs = jax.vmap(pose_errors)(R_b, t_b, R_gts[pad], t_gts[pad])
+        for j, gi in enumerate(sel):
+            r_err, t_err = float(r_errs[j]), float(t_errs[j])
+            rot_errs.append(r_err)
+            trans_errs.append(t_err)
             ok += bool(r_err < 5.0 and t_err < 0.05)
-            expert_ok += expert == fr.expert
-            n_total += 1
+            expert_ok += int(experts[j]) == int(labels_h[gi])
+            times.append(dt)
 
     rot = np.asarray(rot_errs)
     tr = np.asarray(trans_errs)

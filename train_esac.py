@@ -40,8 +40,9 @@ def main(argv=None) -> int:
     p.add_argument("--gating", required=True, help="stage-2 gating checkpoint")
     p.add_argument("--hypotheses", type=int, default=256)
     p.add_argument("--estimator", choices=("dense", "sampled"), default="dense")
-    p.add_argument("--alpha", type=float, default=0.1,
-                   help="softmax selection temperature over hypothesis scores")
+    p.add_argument("--alpha", type=float, default=0.5,
+                   help="softmax selection temperature over hypothesis scores "
+                        "(0.5 per the round-1 sweep: sharp selection trains best)")
     p.add_argument("--loss-clamp", type=float, default=100.0,
                    help="per-hypothesis pose-loss clamp (deg-equivalent)")
     p.add_argument("--output", default="ckpt_esac")
@@ -56,11 +57,23 @@ def main(argv=None) -> int:
     ]
     M = len(datasets)
 
-    e_params, e_nets = [], []
+    e_params, e_cfgs = [], []
     for ck in args.experts:
         params, cfg_d = load_checkpoint(ck)
         e_params.append(params)
-        e_nets.append(make_expert(cfg_d["size"], cfg_d["scene_center"]))
+        e_cfgs.append(cfg_d)
+    sizes = {d["size"] for d in e_cfgs}
+    if len(sizes) != 1:
+        p.error(f"experts must share one size preset, got {sorted(sizes)}")
+    # One shared module + stacked params: the expert forward is a lax.map
+    # over the stacked tree, so compile time is O(1) in M (config #4's ~50
+    # experts), not M unrolled copies of the conv graph.  Per-expert scene
+    # centers move out of the (static) module into a mapped array.
+    e_net = make_expert(sizes.pop(), (0.0, 0.0, 0.0))
+    e_stack = jax.tree.map(lambda *xs: jnp.stack(xs), *e_params)
+    e_centers = jnp.stack(
+        [jnp.asarray(d["scene_center"], jnp.float32) for d in e_cfgs]
+    )  # (M, 3)
     g_params, g_cfg = load_checkpoint(args.gating)
     gating = make_gating(g_cfg["size"], M)
 
@@ -73,18 +86,19 @@ def main(argv=None) -> int:
     cx = jnp.asarray([W / 2.0, H / 2.0])
 
     opt = optax.adam(args.learningrate)
-    opt_state = opt.init((e_params, g_params))
+    opt_state = opt.init((e_stack, g_params))
 
     @jax.jit
     def train_step(params, opt_state, key, images, R_gts, t_gts, focal):
         def loss_fn(ps):
             e_ps, g_p = ps
             logits = gating.apply(g_p, images)  # (B, M)
-            coords = jnp.stack(
-                [e_nets[m].apply(e_ps[m], images) for m in range(M)], axis=1
-            )  # (B, M, h, w, 3)
+            coords = jax.lax.map(
+                lambda pc: e_net.apply(pc[0], images) + pc[1],
+                (e_ps, e_centers),
+            )  # (M, B, h, w, 3)
             B = images.shape[0]
-            coords = coords.reshape(B, M, -1, 3)
+            coords = jnp.moveaxis(coords, 0, 1).reshape(B, M, -1, 3)
             keys = jax.random.split(key, B)
             losses, _ = jax.vmap(
                 lambda k, lg, ca, Rg, tg: esac_train_loss(
@@ -107,7 +121,7 @@ def main(argv=None) -> int:
     focal = jnp.float32(staged[0]["focal"])
 
     rng = np.random.default_rng(args.seed)
-    params = (e_params, g_params)
+    params = (e_stack, g_params)
     t0 = time.time()
     loss = float("nan")
     for it in range(args.iterations):
@@ -120,11 +134,14 @@ def main(argv=None) -> int:
             print(f"iter {it:6d}  E[pose loss] {float(loss):.3f}  "
                   f"({time.time() - t0:.0f}s)", flush=True)
 
-    e_params, g_params = params
-    for m, ck in enumerate(args.experts):
-        _, cfg_d = load_checkpoint(ck)
+    e_stack, g_params = params
+    for m, cfg_d in enumerate(e_cfgs):
         cfg_d["e2e"] = True
-        save_checkpoint(f"{args.output}_expert{m}", e_params[m], cfg_d)
+        save_checkpoint(
+            f"{args.output}_expert{m}",
+            jax.tree.map(lambda x, m=m: x[m], e_stack),
+            cfg_d,
+        )
     g_cfg["e2e"] = True
     save_checkpoint(f"{args.output}_gating", g_params, g_cfg)
     print(f"saved {args.output}_expert*/{args.output}_gating  "
