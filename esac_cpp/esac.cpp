@@ -214,6 +214,105 @@ bool solve_p3p4(const double X[4][3], const double px[4][2], double f, double cx
   return found;
 }
 
+// jax-congruent TOTAL minimal solve (geometry/pnp.py solve_pnp_minimal):
+// every quartic root is evaluated with additive penalties (|imag|, shallow
+// depths, gate degeneracies) and the argmin of (4th-point reprojection error
+// + penalty) wins — a finite pose always comes back, garbage included, so the
+// training backends build IDENTICAL hypothesis sets row by row.  Returns the
+// winning cost (large => degenerate/garbage row).
+double solve_p3p4_total(const double X[4][3], const double px[4][2], double f,
+                        double cx, double cy, double R[9], double t[3]) {
+  static const double I9[9] = {1, 0, 0, 0, 1, 0, 0, 0, 1};
+  std::memcpy(R, I9, sizeof(I9));
+  t[0] = t[1] = 0;
+  t[2] = 1;
+  double b[4][3];
+  for (int i = 0; i < 4; i++) {
+    b[i][0] = (px[i][0] - cx) / f;
+    b[i][1] = (px[i][1] - cy) / f;
+    b[i][2] = 1.0;
+    normalize3(b[i]);
+  }
+  double ca = dot3(b[1], b[2]), cb = dot3(b[0], b[2]), cg = dot3(b[0], b[1]);
+  double d01[3] = {X[0][0] - X[1][0], X[0][1] - X[1][1], X[0][2] - X[1][2]};
+  double d02[3] = {X[0][0] - X[2][0], X[0][1] - X[2][1], X[0][2] - X[2][2]};
+  double d12[3] = {X[1][0] - X[2][0], X[1][1] - X[2][1], X[1][2] - X[2][2]};
+  double asq = dot3(d12, d12), bsq = dot3(d02, d02), csq = dot3(d01, d01);
+  if (asq < 1e-12 || bsq < 1e-12 || csq < 1e-12) return 1e9;  // coincident pts
+  double w = asq - csq;
+  double d1 = 2 * bsq * ca, d0 = -2 * bsq * cg;
+  double e2 = w - bsq, e1 = -2 * w * cb, e0 = bsq + w;
+  double g2 = -csq, g1 = 2 * csq * cb, g0 = bsq - csq;
+  double E2[5] = {e2 * e2, 2 * e2 * e1, 2 * e2 * e0 + e1 * e1, 2 * e1 * e0, e0 * e0};
+  double ED[5] = {0, e2 * d1, e2 * d0 + e1 * d1, e1 * d0 + e0 * d1, e0 * d0};
+  double A2 = d1 * d1, B2 = 2 * d1 * d0, C2 = d0 * d0;
+  double GD2[5] = {g2 * A2, g2 * B2 + g1 * A2, g2 * C2 + g1 * B2 + g0 * A2,
+                   g1 * C2 + g0 * B2, g0 * C2};
+  double Q[5];
+  for (int i = 0; i < 5; i++) Q[i] = bsq * E2[i] + 2 * bsq * cg * ED[i] + GD2[i];
+  cd roots[4];
+  solve_quartic(Q, roots);
+
+  double best_cost = 1e30;
+  for (int k = 0; k < 4; k++) {
+    double v = roots[k].real();
+    double pen = std::fabs(roots[k].imag());
+    double Dv = d1 * v + d0;
+    if (std::fabs(Dv) < 1e-9) pen += 1e3;
+    double Dv_safe = (std::fabs(Dv) < 1e-9) ? (Dv < 0 ? -1e-9 : 1e-9) : Dv;
+    double Ev = (e2 * v + e1) * v + e0;
+    double u = -Ev / Dv_safe;
+    double denom = 1.0 + v * v - 2.0 * v * cb;
+    if (denom < 1e-9) pen += 1e3;
+    double s1 = std::sqrt(std::max(bsq / std::max(denom, 1e-9), 0.0));
+    double s[3] = {s1, u * s1, v * s1};
+    for (int j = 0; j < 3; j++) pen += 1e3 * std::max(0.1 - s[j], 0.0);
+    double Y[3][3];
+    for (int j = 0; j < 3; j++)
+      for (int d = 0; d < 3; d++) Y[j][d] = s[j] * b[j][d];
+    double X3[3][3];
+    std::memcpy(X3, X, sizeof(X3));
+    double Rk[9], tk[3];
+    if (!triad_align(X3, Y, Rk, tk)) continue;  // jax: garbage pose; rare
+    double Yp[3];
+    for (int i = 0; i < 3; i++)
+      Yp[i] = Rk[i * 3] * X[3][0] + Rk[i * 3 + 1] * X[3][1] +
+              Rk[i * 3 + 2] * X[3][2] + tk[i];
+    double z = std::max(Yp[2], 0.1);
+    double uu = f * Yp[0] / z + cx, vv = f * Yp[1] / z + cy;
+    double err4 = std::hypot(uu - px[3][0], vv - px[3][1]);
+    if (Yp[2] < 0.1) err4 += 1000.0;  // behind-camera policy of the jax path
+    double cost = err4 + pen;
+    if (cost < best_cost) {
+      best_cost = cost;
+      std::memcpy(R, Rk, sizeof(Rk));
+      std::memcpy(t, tk, sizeof(tk));
+    }
+  }
+  return best_cost;
+}
+
+// Pose loss vs ground truth (ransac/kernel.py pose_loss): rotation angle in
+// degrees and RE-LOCALIZATION-PROTOCOL translation error — distance between
+// camera centers -R^T t, not between raw translation vectors.
+double pose_loss_vs_gt(const double R[9], const double t[3],
+                       const double R_gt[9], const double t_gt[3],
+                       double trans_scale, double loss_clamp) {
+  double tr_RRt = 0;
+  for (int i = 0; i < 3; i++)
+    for (int k = 0; k < 3; k++) tr_RRt += R[i * 3 + k] * R_gt[i * 3 + k];
+  double cang = std::min(1.0, std::max(-1.0, (tr_RRt - 1.0) / 2.0));
+  double rot_deg = std::acos(cang) * 180.0 / M_PI;
+  double cc[3], cc_gt[3];
+  for (int j = 0; j < 3; j++) {
+    cc[j] = -(R[j] * t[0] + R[3 + j] * t[1] + R[6 + j] * t[2]);
+    cc_gt[j] = -(R_gt[j] * t_gt[0] + R_gt[3 + j] * t_gt[1] + R_gt[6 + j] * t_gt[2]);
+  }
+  double dc[3] = {cc[0] - cc_gt[0], cc[1] - cc_gt[1], cc[2] - cc_gt[2]};
+  double l = std::max(rot_deg, norm3(dc) * trans_scale);
+  return std::min(l, loss_clamp);
+}
+
 // Soft-inlier score of a pose over all cells.
 double score_pose(const double R[9], const double t[3], const float* coords,
                   const float* pixels, int n, double f, double cx, double cy,
@@ -444,6 +543,212 @@ int esac_cpp_infer(const float* coords, const float* pixels, int n_cells,
   std::memcpy(out_R, best_R, sizeof(best_R));
   std::memcpy(out_t, best_t, sizeof(best_t));
   *out_score = best_score;
+  return n_valid;
+}
+
+// Training-mode forward + backward (dense estimator).  The reference's
+// extension serves training by returning per-hypothesis scores/losses and
+// gradients (SURVEY.md §2 #3-4).  Correspondence-set indices are INJECTED
+// (idx, (n_experts, n_hyps, 4)) rather than drawn internally — the sampling
+// contract's injection point, which makes jax and cpp training elementwise
+// comparable on identical hypothesis sets instead of only statistically.
+//
+// Per expert m: solve+polish each minimal set -> soft-inlier score s_h from
+// the UNREFINED pose -> selection probs p = softmax(alpha * s) -> light IRLS
+// refinement (train_refine_iters weighted GN steps) -> pose loss
+// L_h = min(max(rot_deg, ||t - t_gt|| * trans_scale), loss_clamp) ->
+// E_m = sum_h p_h L_h.
+//
+// Backward = two terms, mirroring the reference's split (SURVEY.md §0):
+// (a) analytic selection path: dE_m/dX_i = sum_h alpha p_h (L_h - E_m) *
+//     dscore_h/dX_i with dscore_h/dX_i = -beta s(1-s) dr_i/dX_i through the
+//     unrefined pose (every cell);
+// (b) central finite differences through solve+polish+refinement for the 4
+//     minimal-set coords of each hypothesis (score and loss paths).
+// Refinement's dependence on NON-minimal coords is truncated (the jax
+// backend differentiates it exactly); gradient parity tests therefore run
+// at train_refine_iters=0, where the structures coincide.
+//
+// Returns the number of hypotheses (across experts) whose minimal solve
+// succeeded; failed solves keep the identity pose, scoring as garbage, the
+// same "finite garbage + low score" policy the jax solver uses.
+int esac_cpp_train(const float* coords_all, const float* pixels,
+                   const int32_t* idx, int n_experts, int n_cells, int n_hyps,
+                   float f, float cx, float cy, float tau, float beta,
+                   float alpha, int train_refine_iters, const double* R_gt,
+                   const double* t_gt, float trans_scale, float loss_clamp,
+                   double* out_expert_losses, double* out_scores,
+                   double* out_losses, float* out_grad_coords,
+                   int32_t* out_valid) {
+  if (n_cells < 1) return 0;
+  int n_valid = 0;
+  for (int m = 0; m < n_experts; m++) {
+    const float* coords = coords_all + static_cast<size_t>(m) * n_cells * 3;
+    const int32_t* midx = idx + static_cast<size_t>(m) * n_hyps * 4;
+    double* Rs = new double[9 * n_hyps];
+    double* ts = new double[3 * n_hyps];
+    double* scores = new double[n_hyps];
+    double* losses = new double[n_hyps];
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static) reduction(+ : n_valid)
+#endif
+    for (int h = 0; h < n_hyps; h++) {
+      double X[4][3], px4[4][2];
+      for (int j = 0; j < 4; j++) {
+        int ci = midx[h * 4 + j];
+        for (int d = 0; d < 3; d++) X[j][d] = coords[ci * 3 + d];
+        px4[j][0] = pixels[ci * 2];
+        px4[j][1] = pixels[ci * 2 + 1];
+      }
+      double* R = Rs + 9 * h;
+      double* t = ts + 3 * h;
+      double cost = solve_p3p4_total(X, px4, f, cx, cy, R, t);
+      // "valid" = clean solve (no gate/imag/depth penalty dominating); the
+      // pose is finite either way, mirroring the jax branchless policy.
+      bool ok = cost < 500.0;
+      if (out_valid)
+        out_valid[static_cast<size_t>(m) * n_hyps + h] = ok ? 1 : 0;
+      if (ok) n_valid++;
+      {
+        float X4f[12], px4f[8];
+        for (int j = 0; j < 4; j++) {
+          for (int d = 0; d < 3; d++) X4f[j * 3 + d] = static_cast<float>(X[j][d]);
+          px4f[j * 2] = static_cast<float>(px4[j][0]);
+          px4f[j * 2 + 1] = static_cast<float>(px4[j][1]);
+        }
+        for (int it = 0; it < 3; it++)
+          gn_step(R, t, X4f, px4f, 4, f, cx, cy, 1e6, 1.0);
+      }
+      scores[h] = score_pose(R, t, coords, pixels, n_cells, f, cx, cy, tau, beta);
+      // Light IRLS refinement on a COPY (scores/grads use the unrefined pose).
+      double Rr[9], tr[3];
+      std::memcpy(Rr, R, sizeof(Rr));
+      std::memcpy(tr, t, sizeof(tr));
+      for (int it = 0; it < train_refine_iters; it++)
+        gn_step(Rr, tr, coords, pixels, n_cells, f, cx, cy, tau, beta);
+      // Pose loss vs ground truth.
+      losses[h] = pose_loss_vs_gt(Rr, tr, R_gt, t_gt, trans_scale, loss_clamp);
+    }
+    // Softmax selection (numerically shifted) + expectation.
+    double smax = scores[0];
+    for (int h = 1; h < n_hyps; h++) smax = std::max(smax, scores[h]);
+    double Z = 0;
+    double* probs = new double[n_hyps];
+    for (int h = 0; h < n_hyps; h++) {
+      probs[h] = std::exp(alpha * (scores[h] - smax));
+      Z += probs[h];
+    }
+    double Em = 0;
+    for (int h = 0; h < n_hyps; h++) {
+      probs[h] /= Z;
+      Em += probs[h] * losses[h];
+    }
+    out_expert_losses[m] = Em;
+    if (out_scores)
+      std::memcpy(out_scores + static_cast<size_t>(m) * n_hyps, scores,
+                  n_hyps * sizeof(double));
+    if (out_losses)
+      std::memcpy(out_losses + static_cast<size_t>(m) * n_hyps, losses,
+                  n_hyps * sizeof(double));
+    if (out_grad_coords) {
+      float* gm = out_grad_coords + static_cast<size_t>(m) * n_cells * 3;
+      // --- Solve-path gradient: central finite differences through the
+      // minimal solve (+ polish + light refinement), the reference's own
+      // backward technique for the non-analytic segment (SURVEY.md §0 (b),
+      // §3.5).  Each hypothesis's pose depends on its 4 sampled coords;
+      // perturbing each of the 12 inputs re-runs solve/score/refine/loss.
+      // This is the dominant backward cost, exactly as in the reference.
+      const double eps = 1e-4;
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+      for (int h = 0; h < n_hyps; h++) {
+        double wsel = alpha * probs[h] * (losses[h] - Em);  // dE/dscore_h
+        double wloss = probs[h];                            // dE/dloss_h
+        for (int j = 0; j < 4; j++) {
+          int ci = midx[h * 4 + j];
+          for (int d = 0; d < 3; d++) {
+            double sg[2], lg[2];
+            for (int sgn = 0; sgn < 2; sgn++) {
+              double X[4][3], px4[4][2];
+              for (int jj = 0; jj < 4; jj++) {
+                int cj = midx[h * 4 + jj];
+                for (int dd = 0; dd < 3; dd++) X[jj][dd] = coords[cj * 3 + dd];
+                px4[jj][0] = pixels[cj * 2];
+                px4[jj][1] = pixels[cj * 2 + 1];
+              }
+              X[j][d] += (sgn == 0 ? eps : -eps);
+              double R[9], t[3];
+              solve_p3p4_total(X, px4, f, cx, cy, R, t);
+              float X4f[12], px4f[8];
+              for (int jj = 0; jj < 4; jj++) {
+                for (int dd = 0; dd < 3; dd++)
+                  X4f[jj * 3 + dd] = static_cast<float>(X[jj][dd]);
+                px4f[jj * 2] = static_cast<float>(px4[jj][0]);
+                px4f[jj * 2 + 1] = static_cast<float>(px4[jj][1]);
+              }
+              for (int it = 0; it < 3; it++)
+                gn_step(R, t, X4f, px4f, 4, f, cx, cy, 1e6, 1.0);
+              sg[sgn] = score_pose(R, t, coords, pixels, n_cells, f, cx, cy,
+                                   tau, beta);
+              for (int it = 0; it < train_refine_iters; it++)
+                gn_step(R, t, coords, pixels, n_cells, f, cx, cy, tau, beta);
+              lg[sgn] = pose_loss_vs_gt(R, t, R_gt, t_gt, trans_scale,
+                                        loss_clamp);
+            }
+            double g = wsel * (sg[0] - sg[1]) / (2 * eps) +
+                       wloss * (lg[0] - lg[1]) / (2 * eps);
+            float gf = static_cast<float>(g);
+#ifdef _OPENMP
+#pragma omp atomic
+#endif
+            gm[ci * 3 + d] += gf;
+          }
+        }
+      }
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+      for (int i = 0; i < n_cells; i++) {
+        double gx = 0, gy = 0, gz = 0;
+        double X0 = coords[i * 3], X1 = coords[i * 3 + 1], X2 = coords[i * 3 + 2];
+        double pu = pixels[i * 2], pv = pixels[i * 2 + 1];
+        for (int h = 0; h < n_hyps; h++) {
+          const double* R = Rs + 9 * h;
+          const double* t = ts + 3 * h;
+          double z = R[6] * X0 + R[7] * X1 + R[8] * X2 + t[2];
+          if (z < 0.1) continue;  // clamped branch: zero gradient
+          double x = R[0] * X0 + R[1] * X1 + R[2] * X2 + t[0];
+          double y = R[3] * X0 + R[4] * X1 + R[5] * X2 + t[1];
+          double u = f * x / z + cx, v = f * y / z + cy;
+          double ru = u - pu, rv = v - pv;
+          double r = std::hypot(ru, rv);
+          if (r < 1e-9) continue;
+          double s = 1.0 / (1.0 + std::exp(-beta * (tau - r)));
+          double w = alpha * probs[h] * (losses[h] - Em) * beta * s * (1.0 - s);
+          if (std::fabs(w) < 1e-14) continue;
+          // dr/dX = ((ru du/dX + rv dv/dX)) / r, du/dX = (f/z)(R_row0 - (x/z) R_row2)
+          double fz = f / z, xz = x / z, yz = y / z;
+          double du[3] = {fz * (R[0] - xz * R[6]), fz * (R[1] - xz * R[7]),
+                          fz * (R[2] - xz * R[8])};
+          double dv[3] = {fz * (R[3] - yz * R[6]), fz * (R[4] - yz * R[7]),
+                          fz * (R[5] - yz * R[8])};
+          double coef = -w / r;  // dscore/dr = -beta s(1-s); chain with w
+          gx += coef * (ru * du[0] + rv * dv[0]);
+          gy += coef * (ru * du[1] + rv * dv[1]);
+          gz += coef * (ru * du[2] + rv * dv[2]);
+        }
+        gm[i * 3] += static_cast<float>(gx);
+        gm[i * 3 + 1] += static_cast<float>(gy);
+        gm[i * 3 + 2] += static_cast<float>(gz);
+      }
+    }
+    delete[] probs;
+    delete[] Rs;
+    delete[] ts;
+    delete[] scores;
+    delete[] losses;
+  }
   return n_valid;
 }
 

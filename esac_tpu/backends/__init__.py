@@ -6,6 +6,16 @@ and the self-contained C++/OpenMP reference path in ``esac_cpp/``, which is
 also the measured baseline for the >=20x hypotheses/sec target.
 """
 
-from esac_tpu.backends.cpp import cpp_available, esac_infer_cpp, esac_infer_multi_cpp
+from esac_tpu.backends.cpp import (
+    cpp_available,
+    esac_infer_cpp,
+    esac_infer_multi_cpp,
+    esac_train_cpp,
+)
 
-__all__ = ["cpp_available", "esac_infer_cpp", "esac_infer_multi_cpp"]
+__all__ = [
+    "cpp_available",
+    "esac_infer_cpp",
+    "esac_infer_multi_cpp",
+    "esac_train_cpp",
+]

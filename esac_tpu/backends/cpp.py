@@ -60,6 +60,24 @@ def _load() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_double),  # out_score
         ctypes.POINTER(ctypes.c_double),  # out_scores (may be NULL)
     ]
+    lib.esac_cpp_train.restype = ctypes.c_int
+    lib.esac_cpp_train.argtypes = [
+        ctypes.POINTER(ctypes.c_float),   # coords_all
+        ctypes.POINTER(ctypes.c_float),   # pixels
+        ctypes.POINTER(ctypes.c_int32),   # idx
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,  # n_experts, n_cells, n_hyps
+        ctypes.c_float, ctypes.c_float, ctypes.c_float,  # f, cx, cy
+        ctypes.c_float, ctypes.c_float, ctypes.c_float,  # tau, beta, alpha
+        ctypes.c_int,                     # train_refine_iters
+        ctypes.POINTER(ctypes.c_double),  # R_gt
+        ctypes.POINTER(ctypes.c_double),  # t_gt
+        ctypes.c_float, ctypes.c_float,   # trans_scale, loss_clamp
+        ctypes.POINTER(ctypes.c_double),  # out_expert_losses
+        ctypes.POINTER(ctypes.c_double),  # out_scores (may be NULL)
+        ctypes.POINTER(ctypes.c_double),  # out_losses (may be NULL)
+        ctypes.POINTER(ctypes.c_float),   # out_grad_coords (may be NULL)
+        ctypes.POINTER(ctypes.c_int32),   # out_valid (may be NULL)
+    ]
     lib.esac_cpp_infer_multi.restype = ctypes.c_int
     lib.esac_cpp_infer_multi.argtypes = [
         ctypes.POINTER(ctypes.c_float),   # coords_all
@@ -130,6 +148,77 @@ def esac_infer_cpp(
     }
     if return_scores:
         out["scores"] = scores
+    return out
+
+
+def esac_train_cpp(
+    coords_all: np.ndarray,
+    pixels: np.ndarray,
+    idx: np.ndarray,
+    f: float,
+    c: tuple[float, float],
+    R_gt: np.ndarray,
+    t_gt: np.ndarray,
+    tau: float = 10.0,
+    beta: float = 0.5,
+    alpha: float = 0.5,
+    train_refine_iters: int = 2,
+    trans_scale: float = 100.0,
+    loss_clamp: float = 100.0,
+    want_grad: bool = True,
+) -> dict:
+    """Training-mode forward (+ selection-path backward) on the CPU backend.
+
+    coords_all: (M, N, 3) float32; idx: (M, n_hyps, 4) int32 injected
+    correspondence sets (the sampling-contract injection point — generate
+    them with esac_tpu.ransac.sampling so jax and cpp train on identical
+    hypothesis sets).  Returns dict with 'expert_losses' (M,) expected pose
+    loss per expert, 'scores'/'losses' (M, n_hyps), 'grad_coords' (M, N, 3)
+    = d expert_losses[m] / d coords_all[m] through the selection path, and
+    'n_valid'.
+    """
+    lib = _load()
+    coords_all = np.ascontiguousarray(coords_all, dtype=np.float32)
+    pixels = np.ascontiguousarray(pixels, dtype=np.float32)
+    idx = np.ascontiguousarray(idx, dtype=np.int32)
+    M, n = coords_all.shape[0], coords_all.shape[1]
+    n_hyps = idx.shape[1]
+    if idx.shape != (M, n_hyps, 4):
+        raise ValueError(f"idx shape {idx.shape} != ({M}, n_hyps, 4)")
+    if (idx < 0).any() or (idx >= n).any():
+        raise ValueError("idx out of range")
+    if pixels.shape != (n, 2):
+        raise ValueError(f"pixels shape {pixels.shape} != ({n}, 2)")
+    R_gt = np.ascontiguousarray(R_gt, dtype=np.float64).reshape(9)
+    t_gt = np.ascontiguousarray(t_gt, dtype=np.float64).reshape(3)
+    expert_losses = np.zeros(M, dtype=np.float64)
+    scores = np.zeros((M, n_hyps), dtype=np.float64)
+    losses = np.zeros((M, n_hyps), dtype=np.float64)
+    grad = np.zeros((M, n, 3), dtype=np.float32) if want_grad else None
+    valid = np.zeros((M, n_hyps), dtype=np.int32)
+
+    def ptr(a, ty):
+        return a.ctypes.data_as(ctypes.POINTER(ty)) if a is not None else None
+
+    n_valid = lib.esac_cpp_train(
+        ptr(coords_all, ctypes.c_float), ptr(pixels, ctypes.c_float),
+        ptr(idx, ctypes.c_int32), M, n, n_hyps,
+        f, c[0], c[1], tau, beta, alpha, train_refine_iters,
+        ptr(R_gt, ctypes.c_double), ptr(t_gt, ctypes.c_double),
+        trans_scale, loss_clamp,
+        ptr(expert_losses, ctypes.c_double), ptr(scores, ctypes.c_double),
+        ptr(losses, ctypes.c_double), ptr(grad, ctypes.c_float),
+        ptr(valid, ctypes.c_int32),
+    )
+    out = {
+        "expert_losses": expert_losses,
+        "scores": scores,
+        "losses": losses,
+        "valid": valid.astype(bool),
+        "n_valid": int(n_valid),
+    }
+    if want_grad:
+        out["grad_coords"] = grad
     return out
 
 

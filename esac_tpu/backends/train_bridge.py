@@ -1,0 +1,89 @@
+"""JAX <-> C++ training bridge: the reference's extension-inside-autograd
+architecture.
+
+The reference calls its C++ extension per batch inside the torch autograd
+graph — forward returns the expected pose loss, backward injects the
+extension's gradients into the network backprop (SURVEY.md §3.3).  This
+module reproduces that wiring for ``train_esac.py --backend cpp``: a
+``jax.custom_vjp`` whose forward runs ``esac_cpp_train`` through
+``jax.pure_callback`` (host round-trip per frame — the exact cost the
+TPU-native path exists to eliminate) and whose backward returns the
+extension's analytic + finite-difference coordinate gradients.
+
+Gating gradients need no bridge: in dense mode the total loss is
+``sum_m softmax(logits)_m * E_m`` with ``E_m`` from the extension, so the
+logits gradient is exact with ``E`` held constant.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from esac_tpu.ransac.config import RansacConfig
+
+
+def make_cpp_expert_losses(pixels: jnp.ndarray, f: float, c: tuple[float, float],
+                           cfg: RansacConfig):
+    """Build ``expert_losses(coords_all, R_gt, t_gt, idx) -> (M,)`` running
+    the C++ training extension, differentiable wrt ``coords_all``.
+
+    pixels: (N, 2) cell centers (static per run).  idx: (M, n_hyps, 4) int32
+    correspondence sets drawn by the caller — the sampling contract stays in
+    jax; the extension consumes the sets.  Works under jit and batch vmap
+    (sequential host callbacks, one per frame, like the reference's per-frame
+    extension calls).
+    """
+    px_host = np.asarray(pixels, np.float32)
+
+    def _host_call(want_grad, coords_all, R_gt, t_gt, idx):
+        from esac_tpu.backends.cpp import esac_train_cpp
+
+        out = esac_train_cpp(
+            np.asarray(coords_all), px_host, np.asarray(idx), float(f),
+            (float(c[0]), float(c[1])), np.asarray(R_gt), np.asarray(t_gt),
+            tau=cfg.tau, beta=cfg.beta, alpha=cfg.alpha,
+            train_refine_iters=cfg.train_refine_iters,
+            trans_scale=cfg.trans_scale, loss_clamp=cfg.loss_clamp,
+            want_grad=want_grad,
+        )
+        E = out["expert_losses"].astype(np.float32)
+        if not want_grad:
+            return E
+        return E, out["grad_coords"].astype(np.float32)
+
+    def _call(coords_all, R_gt, t_gt, idx, want_grad):
+        M, N = coords_all.shape[0], coords_all.shape[1]
+        E_shape = jax.ShapeDtypeStruct((M,), jnp.float32)
+        shapes = (
+            (E_shape, jax.ShapeDtypeStruct((M, N, 3), jnp.float32))
+            if want_grad else E_shape
+        )
+        return jax.pure_callback(
+            lambda *a: _host_call(want_grad, *a),
+            shapes,
+            coords_all, R_gt, t_gt, idx,
+            vmap_method="sequential",
+        )
+
+    @jax.custom_vjp
+    def expert_losses(coords_all, R_gt, t_gt, idx):
+        # Forward-only use skips the dominant FD-backward cost entirely.
+        return _call(coords_all, R_gt, t_gt, idx, want_grad=False)
+
+    def fwd(coords_all, R_gt, t_gt, idx):
+        E, grad = _call(coords_all, R_gt, t_gt, idx, want_grad=True)
+        return E, (grad, idx.shape)
+
+    def bwd(res, ct):
+        grad, idx_shape = res
+        return (
+            ct[:, None, None] * grad,
+            jnp.zeros((3, 3), grad.dtype),   # R_gt: ground truth, no gradient
+            jnp.zeros((3,), grad.dtype),     # t_gt
+            np.zeros(idx_shape, jax.dtypes.float0),  # int input -> float0
+        )
+
+    expert_losses.defvjp(fwd, bwd)
+    return expert_losses

@@ -49,6 +49,15 @@ class RansacConfig:
     # validated on hardware (the TPU was unreachable when it was written —
     # see CLAUDE.md); interpret-mode equivalence is tested.
     use_pallas_scoring: bool = False
+    # Differentiate the training expectation through the per-hypothesis
+    # refined pose losses (autodiff-through-IRLS — the jax replacement for
+    # the reference's central-difference machinery).  False restricts the
+    # coords gradient to the score/selection path — a cheaper-backward
+    # ablation.  NOTE: the cpp training backward includes the loss path too
+    # (finite differences through the solve), so the jax-vs-cpp gradient
+    # parity recipe is grad_through_refine=True with train_refine_iters=0
+    # (see tests/test_backend_equivalence.py), NOT this flag.
+    grad_through_refine: bool = True
     # Rematerialize the per-hypothesis refinement in the backward pass
     # (jax.checkpoint): trades ~2x refine FLOPs for O(n_hyps * n_cells)
     # activation memory — needed for config-#5-scale training

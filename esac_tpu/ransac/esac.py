@@ -45,7 +45,7 @@ from esac_tpu.ransac.scoring import soft_inlier_score
 
 
 def _per_expert_hypotheses(key, coords_all, pixels, f, c, cfg, inference=False,
-                           score_key=None):
+                           score_key=None, idx=None):
     """cfg.n_hyps hypotheses per expert. coords_all: (M, N, 3).
 
     Returns rvecs, tvecs (M, n_hyps, 3) and scores (M, n_hyps), each
@@ -61,9 +61,14 @@ def _per_expert_hypotheses(key, coords_all, pixels, f, c, cfg, inference=False,
     else:
         k_sub = score_key
     keys = jax.random.split(key, M)
-    rvecs, tvecs = jax.vmap(
-        lambda k, co: generate_hypotheses(k, co, pixels, f, c, cfg)
-    )(keys, coords_all)
+    if idx is None:
+        rvecs, tvecs = jax.vmap(
+            lambda k, co: generate_hypotheses(k, co, pixels, f, c, cfg)
+        )(keys, coords_all)
+    else:
+        rvecs, tvecs = jax.vmap(
+            lambda k, co, ix: generate_hypotheses(k, co, pixels, f, c, cfg, idx=ix)
+        )(keys, coords_all, idx)
     scores = jax.vmap(
         lambda rv, tv, co: _score_hypotheses(
             k_sub, rv, tv, co, pixels, f, c, cfg, inference=inference
@@ -135,6 +140,9 @@ def _expected_losses_per_expert(rvecs, tvecs, scores, coords_all, pixels, f, c, 
             refine_one = jax.checkpoint(refine_one)
         rv_r, tv_r = jax.vmap(refine_one)(rv, tv)
         losses = jax.vmap(lambda r, t: pose_loss(r, t, R_gt, t_gt, cfg))(rv_r, tv_r)
+        if not cfg.grad_through_refine:
+            # Selection-path-only backward (matches the cpp training backend).
+            losses = jax.lax.stop_gradient(losses)
         return jnp.sum(probs * losses), losses
 
     return jax.vmap(one_expert)(rvecs, tvecs, scores, coords_all)
@@ -189,6 +197,7 @@ def esac_train_loss(
     t_gt: jnp.ndarray,
     cfg: RansacConfig = RansacConfig(),
     mode: str = "dense",
+    idx: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, dict]:
     """End-to-end expected pose loss, differentiable wrt coords AND gating.
 
@@ -197,13 +206,16 @@ def esac_train_loss(
     sampled: reference-parity estimator — experts drawn per hypothesis,
              REINFORCE (score-function) term with expected-loss baseline
              carries the gating gradient (SURVEY.md §0 training stage 3).
+
+    ``idx`` ((M, n_hyps, 4) int32, dense mode only) injects correspondence
+    sets for backend-parity tests.
     """
     g = jax.nn.softmax(gating_logits)
 
     if mode == "dense":
         k_hyp, _ = jax.random.split(key)
         rvecs, tvecs, scores = _per_expert_hypotheses(
-            k_hyp, coords_all, pixels, f, c, cfg
+            k_hyp, coords_all, pixels, f, c, cfg, idx=idx
         )
         exp_losses, losses = _expected_losses_per_expert(
             rvecs, tvecs, scores, coords_all, pixels, f, c, R_gt, t_gt, cfg
@@ -219,6 +231,8 @@ def esac_train_loss(
 
     if mode != "sampled":
         raise ValueError(f"unknown mode {mode!r}")
+    if idx is not None:
+        raise ValueError("idx injection is dense-mode only")
 
     k_draw, k_hyp = jax.random.split(key)
     M, N = coords_all.shape[0], coords_all.shape[1]

@@ -74,13 +74,19 @@ def generate_hypotheses(
     f: jnp.ndarray,
     c: jnp.ndarray,
     cfg: RansacConfig,
+    idx: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Sample minimal sets and solve PnP for every hypothesis.
 
     coords: (N, 3) scene coordinates, pixels: (N, 2).
     Returns rvecs, tvecs of shape (n_hyps, 3).
+
+    ``idx`` ((n_hyps, 4) int32) injects precomputed correspondence sets —
+    the sampling contract's injection point (SURVEY.md hard part #4), used
+    to run jax and cpp backends on identical hypothesis sets.
     """
-    idx = sample_correspondence_sets(key, cfg.n_hyps, coords.shape[0])
+    if idx is None:
+        idx = sample_correspondence_sets(key, cfg.n_hyps, coords.shape[0])
     X4 = coords[idx]  # (n_hyps, 4, 3)
     x4 = pixels[idx]  # (n_hyps, 4, 2)
     solve = jax.vmap(

@@ -122,3 +122,112 @@ def test_cpp_rejects_degenerate_cell_count():
                          return_scores=True)
     assert out["n_valid"] == 0
     assert (out["scores"] == -1.0).all()
+
+
+# ---- training-mode parity (SURVEY.md §2 #3-4: the extension serves training)
+#
+# Correspondence-set INJECTION (esac_train_loss(idx=...) / esac_cpp_train's
+# idx argument) runs both backends on identical hypothesis sets, so training
+# parity is tested ELEMENTWISE, not statistically.  Rows whose P3P root
+# choice flips between float32 (jax production dtype) and float64 (cpp) are
+# expected on ambiguous minimal sets; thresholds below budget for them.
+
+F4 = 525.0 / 4.0
+C4 = (80.0, 60.0)
+TRAIN_KW = dict(height=120, width=160, f=F4, c=C4)
+
+
+def _train_fixture(noise, seed, n_hyps, dtype=jnp.float32):
+    from esac_tpu.ransac.sampling import sample_correspondence_sets_exact
+
+    key = jax.random.key(seed)
+    frame = make_correspondence_frame(key, noise=noise, **TRAIN_KW)
+    co = jnp.asarray(frame["coords"], dtype)
+    px = jnp.asarray(frame["pixels"], dtype)
+    idx = sample_correspondence_sets_exact(
+        jax.random.fold_in(key, 7), n_hyps, co.shape[0]
+    )
+    R_gt = rodrigues(jnp.asarray(frame["rvec"], dtype))
+    t_gt = jnp.asarray(frame["tvec"], dtype)
+    return co, px, idx, R_gt, t_gt
+
+
+@pytest.mark.parametrize("noise,seed", [(0.003, 0), (0.01, 11)])
+def test_train_forward_parity(noise, seed):
+    """Same hypothesis sets -> per-expert expected losses agree within 10%
+    and >=80% of per-hypothesis scores agree elementwise."""
+    from esac_tpu.backends import esac_train_cpp
+    from esac_tpu.ransac import esac_train_loss
+
+    co, px, idx, R_gt, t_gt = _train_fixture(noise, seed, n_hyps=64)
+    cfg = RansacConfig(n_hyps=64, train_refine_iters=2)
+    _, aux = esac_train_loss(
+        jax.random.key(1), jnp.zeros(1), co[None], px, jnp.float32(F4),
+        jnp.asarray(C4), R_gt, t_gt, cfg, "dense", idx[None]
+    )
+    out = esac_train_cpp(
+        np.asarray(co)[None], np.asarray(px), np.asarray(idx)[None], F4, C4,
+        np.asarray(R_gt), np.asarray(t_gt), alpha=cfg.alpha,
+        train_refine_iters=2, want_grad=False,
+    )
+    sj, sc = np.asarray(aux["scores"])[0], out["scores"][0]
+    assert (np.abs(sj - sc) < 0.5).mean() >= 0.8
+    Ej = float(aux["per_expert_loss"][0])
+    Ec = float(out["expert_losses"][0])
+    assert abs(Ej - Ec) / max(Ec, 1e-6) < 0.10
+
+
+def test_train_gradient_parity_x64():
+    """Matched precision (jax x64) + refine=0: the cpp backward (analytic
+    selection path + central differences through the solve, the reference's
+    own technique) must agree in direction and magnitude with jax autodiff."""
+    from esac_tpu.backends import esac_train_cpp
+    from esac_tpu.ransac import esac_train_loss
+
+    with jax.enable_x64(True):
+        co, px, idx, R_gt, t_gt = _train_fixture(
+            0.01, 3, n_hyps=48, dtype=jnp.float64
+        )
+        cfg = RansacConfig(n_hyps=48, train_refine_iters=0)
+        logits = jnp.zeros(1, jnp.float64)
+        f64, c64 = jnp.float64(F4), jnp.asarray(C4, jnp.float64)
+
+        def lossf(ca):
+            return esac_train_loss(
+                jax.random.key(1), logits, ca, px, f64, c64, R_gt, t_gt,
+                cfg, "dense", idx[None]
+            )[0]
+
+        gj = np.asarray(jax.grad(lossf)(co[None]))
+        out = esac_train_cpp(
+            np.asarray(co)[None], np.asarray(px), np.asarray(idx)[None], F4,
+            C4, np.asarray(R_gt), np.asarray(t_gt), alpha=cfg.alpha,
+            train_refine_iters=0,
+        )
+    gc = out["grad_coords"]
+    cos = (gj * gc).sum() / (np.linalg.norm(gj) * np.linalg.norm(gc) + 1e-12)
+    assert cos > 0.95
+    ratio = np.linalg.norm(gc) / (np.linalg.norm(gj) + 1e-12)
+    assert 0.75 < ratio < 1.3
+
+
+def test_train_bridge_gating_gradient_direction():
+    """Through the custom_vjp bridge, the gating gradient must favor the
+    expert whose coordinate map is correct (dense-mode exactness)."""
+    from esac_tpu.backends.train_bridge import make_cpp_expert_losses
+    from esac_tpu.ransac.sampling import sample_correspondence_sets
+
+    co, px, idx0, R_gt, t_gt = _train_fixture(0.01, 0, n_hyps=32)
+    n = co.shape[0]
+    bad = jax.random.uniform(jax.random.key(9), (n, 3), maxval=5.0)
+    coords_all = jnp.stack([bad, co])  # expert 1 is correct
+    cfg = RansacConfig(n_hyps=32, train_refine_iters=1)
+    fn = make_cpp_expert_losses(px, F4, C4, cfg)
+    idx = sample_correspondence_sets(jax.random.key(2), 64, n).reshape(2, 32, 4)
+
+    def loss(logits):
+        E = fn(coords_all, R_gt, t_gt, idx)
+        return jnp.sum(jax.nn.softmax(logits) * E)
+
+    g = jax.grad(loss)(jnp.zeros(2))
+    assert float(g[1]) < 0 < float(g[0])  # push mass toward the correct expert

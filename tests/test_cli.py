@@ -71,3 +71,35 @@ def test_train_expert_augment_flag(tmp_path):
     run("train_expert.py", "synth0", "--cpu", "--size", "test", "--batch", "2",
         "--iterations", "3", "--augment", "--output", str(tmp_path / "aug"))
     assert (tmp_path / "aug" / "config.json").exists()
+
+
+def test_train_esac_backend_cpp(pipeline_ckpts):
+    """--backend cpp trains THROUGH the C++ extension (r1 verdict: the flag
+    used to be silently ignored)."""
+    from esac_tpu.backends import cpp_available
+
+    if not cpp_available():
+        pytest.skip("cpp backend unavailable")
+    d = pipeline_ckpts
+    out = run(
+        "train_esac.py", "synth0", "synth1", "--cpu", "--size", "test",
+        "--backend", "cpp", "--iterations", "2", "--batch", "2",
+        "--hypotheses", "16",
+        "--experts", str(d / "e0"), str(d / "e1"), "--gating", str(d / "g"),
+        "--output", str(d / "esac_cpp"),
+    )
+    assert "E[pose loss]" in out
+    assert (d / "esac_cpp_gating" / "config.json").exists()
+
+
+def test_train_esac_backend_cpp_rejects_sampled(pipeline_ckpts):
+    d = pipeline_ckpts
+    r = subprocess.run(
+        [sys.executable, str(REPO / "train_esac.py"), "synth0", "synth1",
+         "--cpu", "--size", "test", "--backend", "cpp", "--estimator",
+         "sampled", "--iterations", "1",
+         "--experts", str(d / "e0"), str(d / "e1"), "--gating", str(d / "g")],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert r.returncode != 0
+    assert "dense" in r.stderr

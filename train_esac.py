@@ -85,6 +85,21 @@ def main(argv=None) -> int:
                        alpha=args.alpha, loss_clamp=args.loss_clamp)
     cx = jnp.asarray([W / 2.0, H / 2.0])
 
+    cpp_losses = None
+    if args.backend == "cpp":
+        # The reference trains THROUGH its C++ extension (SURVEY.md §3.3);
+        # --backend cpp reproduces that: per-frame host callback for the
+        # hypothesis loop, extension gradients injected into the jax backprop.
+        if args.estimator != "dense":
+            p.error("--backend cpp supports --estimator dense only "
+                    "(the extension implements the dense expectation)")
+        from esac_tpu.backends import cpp_available
+        from esac_tpu.backends.train_bridge import make_cpp_expert_losses
+
+        if not cpp_available():
+            p.error("--backend cpp requested but the C++ backend is unavailable")
+        cpp_losses = make_cpp_expert_losses(pixels, float(f0.focal), (W / 2.0, H / 2.0), cfg)
+
     opt = optax.adam(args.learningrate)
     opt_state = opt.init((e_stack, g_params))
 
@@ -100,11 +115,23 @@ def main(argv=None) -> int:
             B = images.shape[0]
             coords = jnp.moveaxis(coords, 0, 1).reshape(B, M, -1, 3)
             keys = jax.random.split(key, B)
-            losses, _ = jax.vmap(
-                lambda k, lg, ca, Rg, tg: esac_train_loss(
-                    k, lg, ca, pixels, focal, cx, Rg, tg, cfg, args.estimator
-                )
-            )(keys, logits, coords, R_gts, t_gts)
+            if cpp_losses is not None:
+                from esac_tpu.ransac.sampling import sample_correspondence_sets
+
+                def frame_loss(k, lg, ca, Rg, tg):
+                    idx = sample_correspondence_sets(
+                        k, cfg.n_hyps * M, ca.shape[1]
+                    ).reshape(M, cfg.n_hyps, 4)
+                    E = cpp_losses(ca, Rg, tg, idx)
+                    return jnp.sum(jax.nn.softmax(lg) * E)
+
+                losses = jax.vmap(frame_loss)(keys, logits, coords, R_gts, t_gts)
+            else:
+                losses, _ = jax.vmap(
+                    lambda k, lg, ca, Rg, tg: esac_train_loss(
+                        k, lg, ca, pixels, focal, cx, Rg, tg, cfg, args.estimator
+                    )
+                )(keys, logits, coords, R_gts, t_gts)
             return jnp.mean(losses)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
