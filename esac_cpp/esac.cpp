@@ -752,6 +752,127 @@ int esac_cpp_train(const float* coords_all, const float* pixels,
   return n_valid;
 }
 
+// Gating-faithful multi-expert loop (SURVEY.md §0 step 1): each hypothesis
+// DRAWS its expert from the gating distribution, so the hypothesis budget
+// tracks gating mass — the reference's sparse allocation policy, unlike
+// esac_cpp_infer_multi's equal-budget sweep.  A gating miss (true expert at
+// ~zero mass) fails the frame exactly as the reference's drawn-subset (and
+// the jax esac_infer_topk pruning) can.
+// out_counts (n_experts, optional): hypotheses allocated per expert.
+// Returns the winning expert index, or -1 if every solve failed.
+int esac_cpp_infer_gated(const float* coords_all, const float* pixels,
+                         int n_experts, int n_cells, const float* gating,
+                         int n_hyps, float f, float cx, float cy, float tau,
+                         float beta, int refine_iters, uint64_t seed,
+                         double* out_R, double* out_t, double* out_score,
+                         int32_t* out_counts, double* out_scores) {
+  if (n_cells < 4 || n_experts < 1) return -1;
+  if (out_counts)
+    for (int m = 0; m < n_experts; m++) out_counts[m] = 0;
+  // Normalized CDF of the gating distribution.
+  double* cdf = new double[n_experts];
+  double acc = 0;
+  for (int m = 0; m < n_experts; m++) {
+    acc += std::max(0.0f, gating[m]);
+    cdf[m] = acc;
+  }
+  if (acc <= 0) {  // degenerate gate: uniform fallback
+    for (int m = 0; m < n_experts; m++) cdf[m] = m + 1.0;
+    acc = n_experts;
+  }
+  int best_expert = -1;
+  double best_score = -1.0;
+  double best_R[9], best_t[3];
+#ifdef _OPENMP
+#pragma omp parallel
+#endif
+  {
+    double loc_best = -1.0;
+    double loc_R[9], loc_t[3];
+    int loc_expert = -1;
+    int32_t* loc_counts = new int32_t[n_experts]();
+#ifdef _OPENMP
+#pragma omp for schedule(static)
+#endif
+    for (int h = 0; h < n_hyps; h++) {
+      Rng rng(seed * 0x9e3779b97f4a7c15ull + static_cast<uint64_t>(h));
+      // Expert draw: uniform in [0, acc) through the CDF.
+      double urand = (rng.next() >> 11) * (1.0 / 9007199254740992.0) * acc;
+      int m = 0;
+      while (m < n_experts - 1 && urand >= cdf[m]) m++;
+      loc_counts[m]++;
+      const float* coords = coords_all + static_cast<size_t>(m) * n_cells * 3;
+      int idx[4];
+      double R[9], t[3];
+      bool ok = false;
+      for (int attempt = 0; attempt < 16 && !ok; attempt++) {
+        for (int j = 0; j < 4; j++) {
+          bool dup = true;
+          while (dup) {
+            idx[j] = rng.below(n_cells);
+            dup = false;
+            for (int k = 0; k < j; k++) dup |= (idx[k] == idx[j]);
+          }
+        }
+        double X[4][3], px[4][2];
+        for (int j = 0; j < 4; j++) {
+          for (int d = 0; d < 3; d++) X[j][d] = coords[idx[j] * 3 + d];
+          px[j][0] = pixels[idx[j] * 2];
+          px[j][1] = pixels[idx[j] * 2 + 1];
+        }
+        ok = solve_p3p4(X, px, f, cx, cy, R, t);
+        if (ok) {
+          float X4f[12], px4f[8];
+          for (int j = 0; j < 4; j++) {
+            for (int d = 0; d < 3; d++) X4f[j * 3 + d] = static_cast<float>(X[j][d]);
+            px4f[j * 2] = static_cast<float>(px[j][0]);
+            px4f[j * 2 + 1] = static_cast<float>(px[j][1]);
+          }
+          for (int it = 0; it < 3; it++)
+            gn_step(R, t, X4f, px4f, 4, f, cx, cy, 1e6, 1.0);
+        }
+      }
+      double sc = -1.0;
+      if (ok) {
+        sc = score_pose(R, t, coords, pixels, n_cells, f, cx, cy, tau, beta);
+        if (sc > loc_best) {
+          loc_best = sc;
+          loc_expert = m;
+          std::memcpy(loc_R, R, sizeof(R));
+          std::memcpy(loc_t, t, sizeof(t));
+        }
+      }
+      if (out_scores) out_scores[h] = sc;
+    }
+#ifdef _OPENMP
+#pragma omp critical
+#endif
+    {
+      if (out_counts)
+        for (int m = 0; m < n_experts; m++) out_counts[m] += loc_counts[m];
+      if (loc_best > best_score) {
+        best_score = loc_best;
+        best_expert = loc_expert;
+        std::memcpy(best_R, loc_R, sizeof(loc_R));
+        std::memcpy(best_t, loc_t, sizeof(loc_t));
+      }
+    }
+    delete[] loc_counts;
+  }
+  delete[] cdf;
+  if (best_expert < 0) return -1;
+  const float* coords =
+      coords_all + static_cast<size_t>(best_expert) * n_cells * 3;
+  for (int it = 0; it < refine_iters; it++)
+    gn_step(best_R, best_t, coords, pixels, n_cells, f, cx, cy, tau, beta);
+  best_score =
+      score_pose(best_R, best_t, coords, pixels, n_cells, f, cx, cy, tau, beta);
+  std::memcpy(out_R, best_R, sizeof(best_R));
+  std::memcpy(out_t, best_t, sizeof(best_t));
+  *out_score = best_score;
+  return best_expert;
+}
+
 // Multi-expert ESAC loop: per-expert hypothesis pools scored on their own
 // coordinate maps, global winner refined on its expert's map (the native
 // counterpart of esac_tpu.ransac.esac.esac_infer; the reference's extension

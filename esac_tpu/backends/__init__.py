@@ -9,6 +9,7 @@ also the measured baseline for the >=20x hypotheses/sec target.
 from esac_tpu.backends.cpp import (
     cpp_available,
     esac_infer_cpp,
+    esac_infer_gated_cpp,
     esac_infer_multi_cpp,
     esac_train_cpp,
 )
@@ -16,6 +17,7 @@ from esac_tpu.backends.cpp import (
 __all__ = [
     "cpp_available",
     "esac_infer_cpp",
+    "esac_infer_gated_cpp",
     "esac_infer_multi_cpp",
     "esac_train_cpp",
 ]

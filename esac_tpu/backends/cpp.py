@@ -78,6 +78,23 @@ def _load() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_float),   # out_grad_coords (may be NULL)
         ctypes.POINTER(ctypes.c_int32),   # out_valid (may be NULL)
     ]
+    lib.esac_cpp_infer_gated.restype = ctypes.c_int
+    lib.esac_cpp_infer_gated.argtypes = [
+        ctypes.POINTER(ctypes.c_float),   # coords_all
+        ctypes.POINTER(ctypes.c_float),   # pixels
+        ctypes.c_int, ctypes.c_int,       # n_experts, n_cells
+        ctypes.POINTER(ctypes.c_float),   # gating probs
+        ctypes.c_int,                     # n_hyps (total)
+        ctypes.c_float, ctypes.c_float, ctypes.c_float,  # f, cx, cy
+        ctypes.c_float, ctypes.c_float,   # tau, beta
+        ctypes.c_int,                     # refine_iters
+        ctypes.c_uint64,                  # seed
+        ctypes.POINTER(ctypes.c_double),  # out_R
+        ctypes.POINTER(ctypes.c_double),  # out_t
+        ctypes.POINTER(ctypes.c_double),  # out_score
+        ctypes.POINTER(ctypes.c_int32),   # out_counts (may be NULL)
+        ctypes.POINTER(ctypes.c_double),  # out_scores (may be NULL)
+    ]
     lib.esac_cpp_infer_multi.restype = ctypes.c_int
     lib.esac_cpp_infer_multi.argtypes = [
         ctypes.POINTER(ctypes.c_float),   # coords_all
@@ -220,6 +237,60 @@ def esac_train_cpp(
     if want_grad:
         out["grad_coords"] = grad
     return out
+
+
+def esac_infer_gated_cpp(
+    coords_all: np.ndarray,
+    pixels: np.ndarray,
+    gating_probs: np.ndarray,
+    f: float,
+    c: tuple[float, float],
+    n_hyps: int = 256,
+    tau: float = 10.0,
+    beta: float = 0.5,
+    refine_iters: int = 8,
+    seed: int = 0,
+) -> dict:
+    """Gating-faithful multi-expert loop: each hypothesis draws its expert
+    from ``gating_probs`` (SURVEY.md §0 step 1 — the reference's sparse
+    allocation), so a gating miss fails the frame like esac_infer_topk.
+
+    coords_all: (M, N, 3) float32; gating_probs: (M,) nonnegative (need not
+    be normalized).  ``n_hyps`` is the TOTAL budget across experts.  Returns
+    dict with 'R', 't', 'score', 'expert' (-1 if all solves failed) and
+    'counts' (M,) hypotheses allocated per expert.
+    """
+    lib = _load()
+    coords_all = np.ascontiguousarray(coords_all, dtype=np.float32)
+    pixels = np.ascontiguousarray(pixels, dtype=np.float32)
+    gating = np.ascontiguousarray(gating_probs, dtype=np.float32)
+    M, n = coords_all.shape[0], coords_all.shape[1]
+    if gating.shape != (M,):
+        raise ValueError(f"gating shape {gating.shape} != ({M},)")
+    if pixels.shape != (n, 2):
+        raise ValueError(f"pixels shape {pixels.shape} != ({n}, 2)")
+    out_R = np.zeros(9, dtype=np.float64)
+    out_t = np.zeros(3, dtype=np.float64)
+    out_score = np.zeros(1, dtype=np.float64)
+    counts = np.zeros(M, dtype=np.int32)
+
+    def ptr(a, ty):
+        return a.ctypes.data_as(ctypes.POINTER(ty))
+
+    expert = lib.esac_cpp_infer_gated(
+        ptr(coords_all, ctypes.c_float), ptr(pixels, ctypes.c_float), M, n,
+        ptr(gating, ctypes.c_float), n_hyps, f, c[0], c[1], tau, beta,
+        refine_iters, seed,
+        ptr(out_R, ctypes.c_double), ptr(out_t, ctypes.c_double),
+        ptr(out_score, ctypes.c_double), ptr(counts, ctypes.c_int32), None,
+    )
+    return {
+        "R": out_R.reshape(3, 3),
+        "t": out_t,
+        "score": float(out_score[0]),
+        "expert": int(expert),
+        "counts": counts,
+    }
 
 
 def esac_infer_multi_cpp(

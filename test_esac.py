@@ -128,14 +128,19 @@ def main(argv=None) -> int:
             t_b = out["tvec"]
             experts = np.asarray(out["expert"])
         else:
-            from esac_tpu.backends import esac_infer_multi_cpp
+            # Gating-faithful loop (SURVEY.md §0 step 1): hypotheses drawn
+            # from the gating distribution, total budget matching the jax
+            # dense path's hypotheses * M.
+            from esac_tpu.backends import esac_infer_gated_cpp
 
             co_np, px_np = np.asarray(coords_all), np.asarray(pixels)
+            gating_np = np.asarray(jax.nn.softmax(logits, axis=-1))
             Rs, ts, experts = [], [], []
             for j, gi in enumerate(pad):
-                r = esac_infer_multi_cpp(
-                    co_np[j], px_np, float(focals_h[gi]), (W / 2.0, H / 2.0),
-                    n_hyps_per_expert=args.hypotheses, seed=int(gi),
+                r = esac_infer_gated_cpp(
+                    co_np[j], px_np, gating_np[j], float(focals_h[gi]),
+                    (W / 2.0, H / 2.0), n_hyps=args.hypotheses * M,
+                    seed=int(gi),
                 )
                 Rs.append(r["R"]); ts.append(r["t"]); experts.append(r["expert"])
             dt = (time.perf_counter() - t0) / len(pad)

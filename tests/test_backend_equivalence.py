@@ -231,3 +231,70 @@ def test_train_bridge_gating_gradient_direction():
 
     g = jax.grad(loss)(jnp.zeros(2))
     assert float(g[1]) < 0 < float(g[0])  # push mass toward the correct expert
+
+
+# ---- gating-faithful cpp allocation (SURVEY.md §0 step 1)
+
+
+def _expert_maps(key, M, correct, noise=0.01):
+    frame = make_correspondence_frame(key, noise=noise, **TRAIN_KW)
+    n = frame["coords"].shape[0]
+    maps = []
+    for m in range(M):
+        if m == correct:
+            maps.append(np.asarray(frame["coords"]))
+        else:
+            maps.append(np.asarray(
+                jax.random.uniform(jax.random.fold_in(key, m), (n, 3), maxval=5.0)
+            ))
+    return np.stack(maps), frame
+
+
+def test_cpp_gated_allocation_tracks_gating_mass():
+    from esac_tpu.backends import esac_infer_gated_cpp
+
+    coords_all, frame = _expert_maps(jax.random.key(0), 4, correct=1)
+    gating = np.array([0.6, 0.3, 0.1, 0.0], np.float32)
+    out = esac_infer_gated_cpp(
+        coords_all, np.asarray(frame["pixels"]), gating, F4, C4,
+        n_hyps=1000, seed=0,
+    )
+    counts = out["counts"]
+    assert counts.sum() == 1000
+    assert counts[3] == 0                        # zero-mass expert never drawn
+    np.testing.assert_allclose(counts[:3] / 1000.0, gating[:3], atol=0.06)
+
+
+def test_cpp_gated_finds_correct_expert_with_mass():
+    from esac_tpu.backends import esac_infer_gated_cpp
+
+    coords_all, frame = _expert_maps(jax.random.key(1), 4, correct=2)
+    gating = np.array([0.25, 0.25, 0.25, 0.25], np.float32)
+    out = esac_infer_gated_cpp(
+        coords_all, np.asarray(frame["pixels"]), gating, F4, C4, n_hyps=256,
+    )
+    assert out["expert"] == 2
+    r_err, t_err = pose_errors(
+        jnp.asarray(out["R"], jnp.float32), jnp.asarray(out["t"], jnp.float32),
+        rodrigues(frame["rvec"]), frame["tvec"],
+    )
+    assert float(r_err) < 5.0 and float(t_err) < 0.05
+
+
+def test_cpp_gated_miss_fails_frame_like_topk():
+    """True expert at zero gating mass -> no hypotheses on the right map ->
+    bad pose, exactly the jax esac_infer_topk miss semantics."""
+    from esac_tpu.backends import esac_infer_gated_cpp
+
+    coords_all, frame = _expert_maps(jax.random.key(2), 4, correct=3)
+    gating = np.array([0.5, 0.3, 0.2, 0.0], np.float32)
+    out = esac_infer_gated_cpp(
+        coords_all, np.asarray(frame["pixels"]), gating, F4, C4, n_hyps=256,
+    )
+    assert out["counts"][3] == 0
+    assert out["expert"] != 3
+    r_err, t_err = pose_errors(
+        jnp.asarray(out["R"], jnp.float32), jnp.asarray(out["t"], jnp.float32),
+        rodrigues(frame["rvec"]), frame["tvec"],
+    )
+    assert float(r_err) > 5.0 or float(t_err) > 0.05
