@@ -43,6 +43,15 @@ def common_parser(desc: str) -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend for the NN compute as well")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the output checkpoint (params, optimizer "
+                        "state and iteration; data/RNG streams fast-forward so "
+                        "the trajectory matches an uninterrupted run)")
+    p.add_argument("--stop-after", type=int, default=0,
+                   help="checkpoint and exit after this many iterations THIS "
+                        "invocation (0 = run to --iterations); --iterations "
+                        "still sets the LR schedule, so a stopped+resumed run "
+                        "reproduces the uninterrupted trajectory")
     return p
 
 

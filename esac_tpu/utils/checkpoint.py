@@ -30,6 +30,52 @@ def save_checkpoint(path: str | pathlib.Path, params: Any, config: dict) -> None
     (path / "config.json").write_text(json.dumps(config, indent=2))
 
 
+def save_train_state(path: str | pathlib.Path, params: Any, config: dict,
+                     opt_state: Any, iteration: int) -> None:
+    """Resume-capable checkpoint: params + optimizer state + iteration.
+
+    SURVEY.md §5 build target ("Orbax checkpointing of Flax params +
+    optimizer state").  Layout extends ``save_checkpoint`` — eval scripts
+    keep reading ``params``/``config.json``; trainers additionally get
+    ``opt_state/`` and ``config["iteration"]`` for exact resume.
+    """
+    save_checkpoint(path, params, {**config, "iteration": int(iteration)})
+    path = pathlib.Path(path).absolute()
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path / "opt_state", opt_state, force=True)
+
+
+def load_train_state(path: str | pathlib.Path, opt_state_template: Any
+                     ) -> tuple[Any, Any, dict, int]:
+    """Restore (params, opt_state, config, iteration).
+
+    ``opt_state_template`` (e.g. ``opt.init(params)``) supplies the pytree
+    structure — optax states are namedtuples, which Orbax round-trips as
+    plain containers; leaves are restored in traversal order and re-hung on
+    the template's treedef.  Raises FileNotFoundError when the checkpoint
+    has no optimizer state (written by plain ``save_checkpoint``).
+    """
+    params, config = load_checkpoint(path)
+    opt_dir = pathlib.Path(path).absolute() / "opt_state"
+    if not opt_dir.exists():
+        raise FileNotFoundError(f"{opt_dir} (not a resume-capable checkpoint)")
+    with ocp.PyTreeCheckpointer() as ckptr:
+        tree = ckptr.metadata(opt_dir).item_metadata.tree
+        restore_args = jax.tree.map(
+            lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree
+        )
+        raw = ckptr.restore(opt_dir, restore_args=restore_args)
+    leaves = jax.tree.leaves(raw)
+    treedef = jax.tree.structure(opt_state_template)
+    if len(leaves) != treedef.num_leaves:
+        raise ValueError(
+            f"opt_state leaf count {len(leaves)} != template {treedef.num_leaves} "
+            "(optimizer config changed since the checkpoint was written?)"
+        )
+    opt_state = jax.tree.unflatten(treedef, leaves)
+    return params, opt_state, config, int(config.get("iteration", 0))
+
+
 def load_checkpoint(path: str | pathlib.Path) -> tuple[Any, dict]:
     """Restore as HOST numpy arrays: checkpoints written on one topology
     (e.g. the TPU) must load on any other (e.g. the CPU test mesh) — the

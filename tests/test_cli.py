@@ -103,3 +103,21 @@ def test_train_esac_backend_cpp_rejects_sampled(pipeline_ckpts):
     )
     assert r.returncode != 0
     assert "dense" in r.stderr
+
+
+def test_train_esac_resume(pipeline_ckpts):
+    """Stage-3 resume: combined (experts, gating) state + optimizer restore."""
+    d = pipeline_ckpts
+    common = [
+        "train_esac.py", "synth0", "synth1", "--cpu", "--size", "test",
+        "--batch", "2", "--hypotheses", "16", "--iterations", "4",
+        "--experts", str(d / "e0"), str(d / "e1"), "--gating", str(d / "g"),
+        "--output", str(d / "esac_r"),
+    ]
+    run(*common, "--stop-after", "2")
+    assert (d / "esac_r_state" / "opt_state").exists()
+    out = run(*common, "--resume")
+    assert "resumed" in out
+    from esac_tpu.utils.checkpoint import load_checkpoint
+
+    assert load_checkpoint(d / "esac_r_state")[1]["iteration"] == 4

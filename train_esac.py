@@ -29,7 +29,9 @@ from esac_tpu.cli import (
 from esac_tpu.data.synthetic import output_pixel_grid
 from esac_tpu.geometry import rodrigues
 from esac_tpu.ransac import RansacConfig, esac_train_loss
-from esac_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+from esac_tpu.utils.checkpoint import (
+    load_checkpoint, load_train_state, save_checkpoint, save_train_state,
+)
 
 
 def main(argv=None) -> int:
@@ -103,6 +105,15 @@ def main(argv=None) -> int:
     opt = optax.adam(args.learningrate)
     opt_state = opt.init((e_stack, g_params))
 
+    start_it = 0
+    if args.resume:
+        # Stage-3 state lives in one combined dir: (stacked experts, gating).
+        (e_stack, g_params), opt_state, _, start_it = load_train_state(
+            f"{args.output}_state", opt_state
+        )
+        e_stack = jax.tree.map(jnp.asarray, e_stack)
+        print(f"resumed {args.output}_state at iteration {start_it}")
+
     @jax.jit
     def train_step(params, opt_state, key, images, R_gts, t_gts, focal):
         def loss_fn(ps):
@@ -151,8 +162,11 @@ def main(argv=None) -> int:
     params = (e_stack, g_params)
     t0 = time.time()
     loss = float("nan")
+    last_it = start_it
     for it in range(args.iterations):
         idx = jnp.asarray(rng.integers(0, images_d.shape[0], size=args.batch))
+        if it < start_it:  # fast-forward the data stream on resume
+            continue
         params, opt_state, loss = train_step(
             params, opt_state, jax.random.key(args.seed * 7919 + it),
             images_d[idx], R_gts_d[idx], tvecs_d[idx], focal,
@@ -160,8 +174,15 @@ def main(argv=None) -> int:
         if it % max(1, args.iterations // 20) == 0:
             print(f"iter {it:6d}  E[pose loss] {float(loss):.3f}  "
                   f"({time.time() - t0:.0f}s)", flush=True)
+        last_it = it + 1
+        if args.stop_after and last_it - start_it >= args.stop_after:
+            break
 
     e_stack, g_params = params
+    save_train_state(f"{args.output}_state", params, {
+        "kind": "esac_state",
+        "scenes": args.scenes,
+    }, opt_state, iteration=last_it)
     for m, cfg_d in enumerate(e_cfgs):
         cfg_d["e2e"] = True
         save_checkpoint(

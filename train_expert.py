@@ -27,7 +27,7 @@ from esac_tpu.cli import (
     open_scene, scene_center_of,
 )
 from esac_tpu.train import make_expert_train_step
-from esac_tpu.utils.checkpoint import save_checkpoint
+from esac_tpu.utils.checkpoint import load_train_state, save_train_state
 
 
 def main(argv=None) -> int:
@@ -52,6 +52,12 @@ def main(argv=None) -> int:
     opt = optax.adam(optax.cosine_decay_schedule(args.learningrate, args.iterations, 0.05))
     opt_state = opt.init(params)
     step = make_expert_train_step(net, opt)
+
+    out = args.output or f"ckpt_expert_{args.scene}"
+    start_it = 0
+    if args.resume:
+        params, opt_state, _, start_it = load_train_state(out, opt_state)
+        print(f"resumed {out} at iteration {start_it}")
 
     # Stage the whole scene on device once; per-step indexing is a device
     # gather instead of a host->device copy (the remote-TPU tunnel makes
@@ -80,12 +86,15 @@ def main(argv=None) -> int:
     aug_key = jax.random.key(args.seed + 1)
     t0 = time.time()
     loss = float("nan")
+    last_it = start_it
     for it, idx in enumerate(epoch_batches(rng, len(ds), args.batch)):
         if it >= args.iterations:
             break
+        if it < start_it:  # fast-forward the data stream on resume
+            continue
         idx = jnp.asarray(idx)
         if args.augment:
-            aug_key, sub = jax.random.split(aug_key)
+            sub = jax.random.fold_in(aug_key, it)  # per-iteration: resume-exact
             images_b, coords_b = augment_batch(sub, idx)
             masks_b = (jnp.abs(coords_b).sum(-1) > 1e-9).astype(jnp.float32)
         else:
@@ -96,15 +105,17 @@ def main(argv=None) -> int:
         if it % max(1, args.iterations // 20) == 0:
             print(f"iter {it:7d}  coord L1 {float(loss):.4f}  "
                   f"({(time.time() - t0):.0f}s)", flush=True)
+        last_it = it + 1
+        if args.stop_after and last_it - start_it >= args.stop_after:
+            break
 
-    out = args.output or f"ckpt_expert_{args.scene}"
-    save_checkpoint(out, params, {
+    save_train_state(out, params, {
         "kind": "expert",
         "size": args.size,
         "scene": args.scene,
         "scene_center": [float(x) for x in center],
         "final_loss": float(loss),
-    })
+    }, opt_state, iteration=last_it)
     print(f"saved {out}  final coord L1 {float(loss):.4f}")
     return 0
 

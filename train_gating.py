@@ -21,7 +21,7 @@ from esac_tpu.cli import (
     open_scene,
 )
 from esac_tpu.train import make_gating_train_step
-from esac_tpu.utils.checkpoint import save_checkpoint
+from esac_tpu.utils.checkpoint import load_train_state, save_train_state
 
 
 def main(argv=None) -> int:
@@ -44,6 +44,11 @@ def main(argv=None) -> int:
     opt_state = opt.init(params)
     step = make_gating_train_step(net, opt)
 
+    start_it = 0
+    if args.resume:
+        params, opt_state, _, start_it = load_train_state(args.output, opt_state)
+        print(f"resumed {args.output} at iteration {start_it}")
+
     import jax.numpy as jnp
 
     # Stage all scenes on device once (see train_expert.py).
@@ -54,19 +59,25 @@ def main(argv=None) -> int:
     rng = np.random.default_rng(args.seed)
     t0 = time.time()
     loss = float("nan")
+    last_it = start_it
     for it in range(args.iterations):
         idx = jnp.asarray(rng.integers(0, images_d.shape[0], size=args.batch))
+        if it < start_it:  # fast-forward the data stream on resume
+            continue
         params, opt_state, loss = step(params, opt_state, images_d[idx], labels_d[idx])
         if it % max(1, args.iterations // 20) == 0:
             print(f"iter {it:7d}  CE {float(loss):.4f}  ({time.time() - t0:.0f}s)",
                   flush=True)
+        last_it = it + 1
+        if args.stop_after and last_it - start_it >= args.stop_after:
+            break
 
-    save_checkpoint(args.output, params, {
+    save_train_state(args.output, params, {
         "kind": "gating",
         "size": args.size,
         "scenes": args.scenes,
         "final_loss": float(loss),
-    })
+    }, opt_state, iteration=last_it)
     print(f"saved {args.output}  final CE {float(loss):.4f}")
     return 0
 
