@@ -17,6 +17,7 @@
 #include <cstdint>
 #include <cstring>
 #include <algorithm>
+#include <vector>
 
 #ifdef _OPENMP
 #include <omp.h>
@@ -439,6 +440,54 @@ void gn_step(double R[9], double t[3], const float* coords, const float* pixels,
   t[2] -= dx[5];
 }
 
+// Per-thread best-(score,pose) slot.  The hypothesis loops write one slot per
+// OpenMP thread and the calling thread reduces the slots after the join — no
+// shared mutable state exists inside the parallel regions, which keeps them
+// lock-free AND lets ThreadSanitizer check the loop bodies directly (an
+// `omp critical` reduction would be a TSAN false positive: GCC ships libgomp
+// uninstrumented, so its lock primitives are invisible).
+struct ThreadBest {
+  double score = -1.0;
+  double R[9];
+  double t[3];
+  int valid = 0;
+  int expert = -1;
+};
+
+inline int omp_slots() {
+#ifdef _OPENMP
+  return omp_get_max_threads();
+#else
+  return 1;
+#endif
+}
+
+inline int omp_slot_id() {
+#ifdef _OPENMP
+  return omp_get_thread_num();
+#else
+  return 0;
+#endif
+}
+
+// libgomp's fork/join barriers are also invisible to TSAN, which makes the
+// closure handoff (master writes shared-var struct -> workers read it) and
+// the join (workers' slot writes -> master's reduction reads) look like
+// races.  Model exactly those two barrier edges with happens-before
+// annotations; they compile to nothing outside -fsanitize=thread builds.
+#if defined(__SANITIZE_THREAD__)
+extern "C" void AnnotateHappensBefore(const char* f, int l,
+                                      const volatile void* addr);
+extern "C" void AnnotateHappensAfter(const char* f, int l,
+                                     const volatile void* addr);
+#define ESAC_HB_RELEASE(addr) AnnotateHappensBefore(__FILE__, __LINE__, addr)
+#define ESAC_HB_ACQUIRE(addr) AnnotateHappensAfter(__FILE__, __LINE__, addr)
+#else
+#define ESAC_HB_RELEASE(addr) ((void)0)
+#define ESAC_HB_ACQUIRE(addr) ((void)0)
+#endif
+static char g_fork_tag, g_join_tag;
+
 }  // namespace
 
 extern "C" {
@@ -458,16 +507,18 @@ int esac_cpp_infer(const float* coords, const float* pixels, int n_cells,
       for (int h = 0; h < n_hyps; h++) out_scores[h] = -1.0;
     return 0;
   }
-  int n_valid = 0;
-  double best_score = -1.0;
-  double best_R[9], best_t[3];
+  std::vector<ThreadBest> slots(omp_slots());
+  ThreadBest* slot_base = slots.data();
+  ESAC_HB_RELEASE(&g_fork_tag);
 #ifdef _OPENMP
 #pragma omp parallel
 #endif
   {
-    double loc_best = -1.0;
-    double loc_R[9], loc_t[3];
-    int loc_valid = 0;
+    ESAC_HB_ACQUIRE(&g_fork_tag);
+    // Accumulate in locals; publish to this thread's slot once at the end
+    // (slots are contiguous, so per-hypothesis slot writes would false-share
+    // cache lines between threads).
+    ThreadBest loc;
 #ifdef _OPENMP
 #pragma omp for schedule(static)
 #endif
@@ -511,26 +562,29 @@ int esac_cpp_infer(const float* coords, const float* pixels, int n_cells,
       }
       double sc = -1.0;
       if (ok) {
-        loc_valid++;
+        loc.valid++;
         sc = score_pose(R, t, coords, pixels, n_cells, f, cx, cy, tau, beta);
-        if (sc > loc_best) {
-          loc_best = sc;
-          std::memcpy(loc_R, R, sizeof(R));
-          std::memcpy(loc_t, t, sizeof(t));
+        if (sc > loc.score) {
+          loc.score = sc;
+          std::memcpy(loc.R, R, sizeof(R));
+          std::memcpy(loc.t, t, sizeof(t));
         }
       }
       if (out_scores) out_scores[h] = sc;
     }
-#ifdef _OPENMP
-#pragma omp critical
-#endif
-    {
-      n_valid += loc_valid;
-      if (loc_best > best_score) {
-        best_score = loc_best;
-        std::memcpy(best_R, loc_R, sizeof(loc_R));
-        std::memcpy(best_t, loc_t, sizeof(loc_t));
-      }
+    slot_base[omp_slot_id()] = loc;
+    ESAC_HB_RELEASE(&g_join_tag);
+  }
+  ESAC_HB_ACQUIRE(&g_join_tag);
+  int n_valid = 0;
+  double best_score = -1.0;
+  double best_R[9], best_t[3];
+  for (const ThreadBest& s : slots) {
+    n_valid += s.valid;
+    if (s.score > best_score) {
+      best_score = s.score;
+      std::memcpy(best_R, s.R, sizeof(s.R));
+      std::memcpy(best_t, s.t, sizeof(s.t));
     }
   }
   if (best_score < 0) return 0;
@@ -780,16 +834,19 @@ int esac_cpp_infer_gated(const float* coords_all, const float* pixels,
     for (int m = 0; m < n_experts; m++) cdf[m] = m + 1.0;
     acc = n_experts;
   }
-  int best_expert = -1;
-  double best_score = -1.0;
-  double best_R[9], best_t[3];
+  std::vector<ThreadBest> slots(omp_slots());
+  std::vector<int32_t> slot_counts(
+      static_cast<size_t>(slots.size()) * n_experts, 0);
+  ThreadBest* slot_base = slots.data();
+  int32_t* counts_base = slot_counts.data();
+  ESAC_HB_RELEASE(&g_fork_tag);
 #ifdef _OPENMP
 #pragma omp parallel
 #endif
   {
-    double loc_best = -1.0;
-    double loc_R[9], loc_t[3];
-    int loc_expert = -1;
+    ESAC_HB_ACQUIRE(&g_fork_tag);
+    // Locals + publish-once, as in esac_cpp_infer (false-sharing avoidance).
+    ThreadBest loc;
     int32_t* loc_counts = new int32_t[n_experts]();
 #ifdef _OPENMP
 #pragma omp for schedule(static)
@@ -835,31 +892,37 @@ int esac_cpp_infer_gated(const float* coords_all, const float* pixels,
       double sc = -1.0;
       if (ok) {
         sc = score_pose(R, t, coords, pixels, n_cells, f, cx, cy, tau, beta);
-        if (sc > loc_best) {
-          loc_best = sc;
-          loc_expert = m;
-          std::memcpy(loc_R, R, sizeof(R));
-          std::memcpy(loc_t, t, sizeof(t));
+        if (sc > loc.score) {
+          loc.score = sc;
+          loc.expert = m;
+          std::memcpy(loc.R, R, sizeof(R));
+          std::memcpy(loc.t, t, sizeof(t));
         }
       }
       if (out_scores) out_scores[h] = sc;
     }
-#ifdef _OPENMP
-#pragma omp critical
-#endif
-    {
-      if (out_counts)
-        for (int m = 0; m < n_experts; m++) out_counts[m] += loc_counts[m];
-      if (loc_best > best_score) {
-        best_score = loc_best;
-        best_expert = loc_expert;
-        std::memcpy(best_R, loc_R, sizeof(loc_R));
-        std::memcpy(best_t, loc_t, sizeof(loc_t));
-      }
-    }
+    slot_base[omp_slot_id()] = loc;
+    std::memcpy(counts_base + omp_slot_id() * n_experts, loc_counts,
+                sizeof(int32_t) * n_experts);
     delete[] loc_counts;
+    ESAC_HB_RELEASE(&g_join_tag);
   }
+  ESAC_HB_ACQUIRE(&g_join_tag);
   delete[] cdf;
+  int best_expert = -1;
+  double best_score = -1.0;
+  double best_R[9], best_t[3];
+  for (size_t s = 0; s < slots.size(); s++) {
+    if (out_counts)
+      for (int m = 0; m < n_experts; m++)
+        out_counts[m] += slot_counts[s * n_experts + m];
+    if (slots[s].score > best_score) {
+      best_score = slots[s].score;
+      best_expert = slots[s].expert;
+      std::memcpy(best_R, slots[s].R, sizeof(slots[s].R));
+      std::memcpy(best_t, slots[s].t, sizeof(slots[s].t));
+    }
+  }
   if (best_expert < 0) return -1;
   const float* coords =
       coords_all + static_cast<size_t>(best_expert) * n_cells * 3;

@@ -1,0 +1,99 @@
+// ThreadSanitizer harness for the C++ hypothesis loop (SURVEY.md §5: keep
+// TSAN on the backend's shared-state reductions).  Builds esac.cpp +
+// this main() into one -fsanitize=thread executable and exercises the
+// multi-threaded paths on a small synthetic frame:
+//   - esac_cpp_infer: per-thread best-slot reduction
+//   - esac_cpp_infer_gated: per-hypothesis expert draws + the same reduction
+// Run with OMP_NUM_THREADS>=4; TSAN reports any data race on stderr and
+// (with TSAN_OPTIONS=exitcode=66) fails the process.
+// tests/test_checkpoint.py builds AND runs this.
+//
+// argv[1] selects which entry runs: "infer", "gated", or absent for both.
+// Under TSAN the test runs the binary once PER entry: libgomp's thread pool
+// makes only the FIRST parallel region's fork TSAN-visible (fresh
+// pthread_create); later regions wake pooled threads through a futex TSAN
+// cannot see, so the workers' closure-prologue loads falsely race with the
+// caller's closure writes.  One region per process keeps every fork edge
+// observable; join edges and in-region state are annotation/slot-covered in
+// esac.cpp and stay verifiable in any position.
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+int esac_cpp_infer(const float* coords, const float* pixels, int n_cells,
+                   float f, float cx, float cy, int n_hyps, float tau,
+                   float beta, int refine_iters, uint64_t seed, double* out_R,
+                   double* out_t, double* out_score, double* out_scores);
+int esac_cpp_infer_gated(const float* coords_all, const float* pixels,
+                         int n_experts, int n_cells, const float* gating,
+                         int n_hyps, float f, float cx, float cy, float tau,
+                         float beta, int refine_iters, uint64_t seed,
+                         double* out_R, double* out_t, double* out_score,
+                         int32_t* out_counts, double* out_scores);
+}
+
+int main(int argc, char** argv) {
+  const bool run_infer = argc < 2 || std::strcmp(argv[1], "infer") == 0;
+  const bool run_gated = argc < 2 || std::strcmp(argv[1], "gated") == 0;
+  if (!run_infer && !run_gated) {
+    std::fprintf(stderr, "unknown mode '%s' (want: infer | gated)\n", argv[1]);
+    return 2;
+  }
+  // Synthetic frame: a 10x10 grid of 3D points on two depth planes, imaged
+  // by an identity-rotation camera at the origin.
+  const int n_cells = 100;
+  const float f = 100.0f, cx = 40.0f, cy = 30.0f;
+  std::vector<float> coords(n_cells * 3), pixels(n_cells * 2);
+  for (int i = 0; i < n_cells; i++) {
+    float x = (i % 10) * 0.1f - 0.45f;
+    float y = (i / 10) * 0.1f - 0.45f;
+    float z = 2.0f + 0.5f * ((i % 3 == 0) ? 1.0f : 0.0f);
+    coords[3 * i + 0] = x;
+    coords[3 * i + 1] = y;
+    coords[3 * i + 2] = z;
+    pixels[2 * i + 0] = f * x / z + cx;
+    pixels[2 * i + 1] = f * y / z + cy;
+  }
+  const int n_hyps = 64;
+  double R[9], t[3], score;
+  std::vector<double> scores(n_hyps);
+
+  int valid = 0;
+  if (run_infer) {
+    valid = esac_cpp_infer(coords.data(), pixels.data(), n_cells, f, cx, cy,
+                           n_hyps, 10.0f, 0.5f, 8, 7ull, R, t, &score,
+                           scores.data());
+    if (valid <= 0) {
+      std::fprintf(stderr, "infer: no valid hypotheses\n");
+      return 1;
+    }
+  }
+
+  // Two-expert gated path: expert 0 is the real scene, expert 1 is garbage.
+  std::vector<float> coords2(2 * n_cells * 3);
+  for (int i = 0; i < n_cells * 3; i++) {
+    coords2[i] = coords[i];
+    coords2[n_cells * 3 + i] = 100.0f + i;  // nonsense scene
+  }
+  const float gating[2] = {0.8f, 0.2f};
+  int32_t counts[2] = {0, 0};
+  int expert = 0;
+  if (run_gated) {
+    expert = esac_cpp_infer_gated(coords2.data(), pixels.data(), 2, n_cells,
+                                  gating, n_hyps, f, cx, cy, 10.0f, 0.5f, 8,
+                                  11ull, R, t, &score, counts, scores.data());
+    if (expert != 0 || counts[0] + counts[1] != n_hyps ||
+        counts[0] <= counts[1]) {
+      std::fprintf(stderr, "gated: expert=%d counts=%d,%d\n", expert,
+                   counts[0], counts[1]);
+      return 1;
+    }
+  }
+  std::printf(
+      "tsan-harness-ok valid=%d expert=%d counts=%d,%d score=%.3f\n", valid,
+      expert, counts[0], counts[1], score);
+  return 0;
+}
