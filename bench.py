@@ -41,6 +41,8 @@ import time
 N_HYPS = 256
 BATCH = 16          # frames vmapped per dispatch to saturate the chip
 REPEATS = 20
+STREAM_MESH_CHIPS = 8   # config #5's mesh size; single-device runs measure
+STREAM_BATCH = 64       # one chip's shard (STREAM_BATCH // STREAM_MESH_CHIPS)
 C = (320.0, 240.0)
 PROBE_DEADLINE_S = 180      # backend init + tiny matmul; generous for a cold relay
 DEVICE_DEADLINE_S = 900     # first-compile can be slow; poll, never kill
@@ -81,12 +83,12 @@ def _measure_jax(
     n_chips = 1
     n_dev = jax.device_count()
     if shard_data and n_dev == 1:
-        # Config #5 is spec'd for an 8-chip mesh (BASELINE.md: 64 frames
-        # data-sharded); the full batch OOMs one chip's HBM (measured:
-        # 23.45G vs 15.75G on v5e).  With a single device, measure one
-        # chip's shard of the 8-way mesh — the same per-chip workload, so
-        # the per-chip rate is directly comparable.
-        batch = max(1, batch // 8)
+        # Config #5 is spec'd for a STREAM_MESH_CHIPS mesh (BASELINE.md: 64
+        # frames data-sharded); the full batch OOMs one chip's HBM
+        # (measured: 23.45G vs 15.75G on v5e).  With a single device,
+        # measure one chip's shard of that mesh — the same per-chip
+        # workload, so the per-chip rate is directly comparable.
+        batch = max(1, batch // STREAM_MESH_CHIPS)
         coords, pixels = coords[:batch], pixels[:batch]
     elif shard_data and n_dev > 1 and batch % n_dev == 0:
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -252,7 +254,7 @@ def main() -> None:
         return
     streaming = len(sys.argv) > 1 and sys.argv[1] == "streaming"
     kwargs = (
-        dict(batch=64, n_hyps=4096, repeats=5, shard_data=True)
+        dict(batch=STREAM_BATCH, n_hyps=4096, repeats=5, shard_data=True)
         if streaming else {}
     )
     # The parent never touches the accelerator: everything below runs on the

@@ -22,6 +22,7 @@ see PARALLELISM.md at the repo root for the explicit mapping.
 
 from esac_tpu.parallel.mesh import make_mesh, expert_sharding, batch_sharding
 from esac_tpu.parallel.esac_sharded import esac_infer_sharded
+from esac_tpu.parallel.multihost import initialize_multihost
 from esac_tpu.parallel.train_sharded import make_sharded_esac_loss, shard_esac_params
 
 __all__ = [
@@ -29,6 +30,7 @@ __all__ = [
     "expert_sharding",
     "batch_sharding",
     "esac_infer_sharded",
+    "initialize_multihost",
     "make_sharded_esac_loss",
     "shard_esac_params",
 ]

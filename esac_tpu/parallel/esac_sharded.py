@@ -57,8 +57,7 @@ def esac_infer_sharded(
         k_hyp, k_sub = _split_score_key(k, cfg)
         k_local = jax.random.fold_in(k_hyp, shard_id)
         rvecs, tvecs, scores = _per_expert_hypotheses(
-            k_local, coords_local, px, f, c, cfg, inference=True,
-            score_key=k_sub,
+            k_local, coords_local, px, f, c, cfg, score_key=k_sub,
         )  # (m_local, nh, 3), (m_local, nh)
 
         # Local winner + full refinement (each device refines one pose).

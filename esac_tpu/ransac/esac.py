@@ -44,7 +44,7 @@ from esac_tpu.ransac.sampling import sample_expert_indices
 from esac_tpu.ransac.scoring import soft_inlier_score
 
 
-def _per_expert_hypotheses(key, coords_all, pixels, f, c, cfg, inference=False,
+def _per_expert_hypotheses(key, coords_all, pixels, f, c, cfg,
                            score_key=None, idx=None):
     """cfg.n_hyps hypotheses per expert. coords_all: (M, N, 3).
 
@@ -70,9 +70,7 @@ def _per_expert_hypotheses(key, coords_all, pixels, f, c, cfg, inference=False,
             lambda k, co, ix: generate_hypotheses(k, co, pixels, f, c, cfg, idx=ix)
         )(keys, coords_all, idx)
     scores = jax.vmap(
-        lambda rv, tv, co: _score_hypotheses(
-            k_sub, rv, tv, co, pixels, f, c, cfg, inference=inference
-        )
+        lambda rv, tv, co: _score_hypotheses(k_sub, rv, tv, co, pixels, f, c, cfg)
     )(rvecs, tvecs, coords_all)
     return rvecs, tvecs, scores
 
@@ -98,7 +96,7 @@ def esac_infer(
     'scores' (M, n_hyps), 'gating_probs'.
     """
     rvecs, tvecs, scores = _per_expert_hypotheses(
-        key, coords_all, pixels, f, c, cfg, inference=True
+        key, coords_all, pixels, f, c, cfg
     )
     M, nh = scores.shape
     flat = jnp.argmax(scores.reshape(-1))

@@ -34,20 +34,18 @@ from esac_tpu.ransac.scoring import (
 )
 
 
-def _score_hypotheses(key, rvecs, tvecs, coords, pixels, f, c, cfg,
-                      inference: bool = False):
+def _score_hypotheses(key, rvecs, tvecs, coords, pixels, f, c, cfg):
     """Soft-inlier scores, optionally on a cell subsample (cfg.score_cells).
 
     The single source of truth for hypothesis scoring — the ESAC multi-expert
     path calls this too, so scale corrections stay in one place.
 
-    ``inference`` gates the fused Pallas kernel: it defines no VJP, so
-    training paths always take the differentiable XLA route even when
-    cfg.use_pallas_scoring is set (a silent fallback beats the bare
-    AssertionError Pallas AD raises at trace time).
+    The fused Pallas kernel carries a custom_vjp (analytic XLA backward
+    mirroring the kernel math), so training and inference both honor
+    cfg.use_pallas_scoring.
     """
     coords_s, pixels_s, scale = subsample_cells(key, coords, pixels, cfg.score_cells)
-    if cfg.use_pallas_scoring and inference:
+    if cfg.use_pallas_scoring:
         from esac_tpu.ransac.pallas_scoring import soft_inlier_scores_pallas
 
         return soft_inlier_scores_pallas(
@@ -128,9 +126,7 @@ def dsac_infer(
     """
     key, k_sub = _split_score_key(key, cfg)
     rvecs, tvecs = generate_hypotheses(key, coords, pixels, f, c, cfg)
-    scores = _score_hypotheses(
-        k_sub, rvecs, tvecs, coords, pixels, f, c, cfg, inference=True
-    )
+    scores = _score_hypotheses(k_sub, rvecs, tvecs, coords, pixels, f, c, cfg)
     best = jnp.argmax(scores)
     rvec, tvec = refine_soft_inliers(
         rvecs[best],

@@ -20,6 +20,13 @@ Layout (see /opt/skills/guides/pallas_guide.md):
 Gated behind ``RansacConfig.use_pallas_scoring`` (default off) until
 validated on hardware; ``interpret=True`` runs the same kernel on CPU for
 the equivalence tests.
+
+Differentiable: a ``jax.custom_vjp`` pairs the fused forward with an
+analytic XLA backward that recomputes the kernel's math op-for-op in f32
+broadcast products (``_scores_xla_mirror``) and differentiates it — the
+scoring backward is itself one fused elementwise+reduce XLA program, so a
+hand-written backward kernel would save only the recompute, not a second
+HBM round trip.  Training paths may therefore enable the kernel too.
 """
 
 from __future__ import annotations
@@ -95,8 +102,7 @@ def _pad_to(x: jnp.ndarray, axis: int, multiple: int, value: float) -> jnp.ndarr
     return jnp.pad(x, pad, constant_values=value)
 
 
-@partial(jax.jit, static_argnames=("tau", "beta", "interpret"))
-def soft_inlier_scores_pallas(
+def _scores_pallas_raw(
     Rs: jnp.ndarray,
     ts: jnp.ndarray,
     coords: jnp.ndarray,
@@ -154,3 +160,77 @@ def soft_inlier_scores_pallas(
         interpret=interpret,
     )(scalars, poses, coords_t, pixels_t)
     return out[:H, 0]
+
+
+def _scores_xla_mirror(Rs, ts, coords, pixels, f, c, tau, beta):
+    """The kernel's math, op-for-op, as plain XLA — the backward recompute.
+
+    Mirrors ``_score_kernel`` exactly (same broadcast-product transform in
+    f32, same MIN_DEPTH clamp, eps and behind-camera penalty) so the
+    custom_vjp's gradients are the gradients *of the kernel*, not of a
+    subtly different formula.  Broadcast products, not einsum: the K=3
+    contraction would otherwise hit the MXU in bf16 on TPU.
+    """
+    Rsf = Rs.reshape(Rs.shape[0], 9).astype(jnp.float32)
+    tsf = ts.astype(jnp.float32)
+    X0 = coords[:, 0].astype(jnp.float32)[None, :]  # (1, N)
+    X1 = coords[:, 1].astype(jnp.float32)[None, :]
+    X2 = coords[:, 2].astype(jnp.float32)[None, :]
+    px = pixels[:, 0].astype(jnp.float32)[None, :]
+    py = pixels[:, 1].astype(jnp.float32)[None, :]
+
+    def col(k):
+        return Rsf[:, k][:, None]  # (H, 1)
+
+    Yx = col(0) * X0 + col(1) * X1 + col(2) * X2 + tsf[:, 0][:, None]
+    Yy = col(3) * X0 + col(4) * X1 + col(5) * X2 + tsf[:, 1][:, None]
+    Yz = col(6) * X0 + col(7) * X1 + col(8) * X2 + tsf[:, 2][:, None]
+    z = jnp.maximum(Yz, MIN_DEPTH)
+    du = f * Yx / z + c[0] - px
+    dv = f * Yy / z + c[1] - py
+    err = jnp.sqrt(du * du + dv * dv + 1e-12)
+    err = jnp.where(Yz < MIN_DEPTH, err + 1000.0, err)
+    return jnp.sum(jax.nn.sigmoid(beta * (tau - err)), axis=1)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def _scores_pallas_vjp(Rs, ts, coords, pixels, f, c, tau, beta, interpret):
+    return _scores_pallas_raw(Rs, ts, coords, pixels, f, c, tau, beta,
+                              interpret)
+
+
+def _scores_fwd(Rs, ts, coords, pixels, f, c, tau, beta, interpret):
+    out = _scores_pallas_raw(Rs, ts, coords, pixels, f, c, tau, beta,
+                             interpret)
+    return out, (Rs, ts, coords, pixels, f, c)
+
+
+def _scores_bwd(tau, beta, interpret, res, g):
+    Rs, ts, coords, pixels, f, c = res
+    _, vjp = jax.vjp(
+        lambda *args: _scores_xla_mirror(*args, tau, beta),
+        Rs, ts, coords, pixels, f, c,
+    )
+    return vjp(g)
+
+
+_scores_pallas_vjp.defvjp(_scores_fwd, _scores_bwd)
+
+
+@partial(jax.jit, static_argnames=("tau", "beta", "interpret"))
+def soft_inlier_scores_pallas(
+    Rs: jnp.ndarray,
+    ts: jnp.ndarray,
+    coords: jnp.ndarray,
+    pixels: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    tau: float,
+    beta: float,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Differentiable fused soft-inlier scores (see ``_scores_pallas_raw``
+    for shapes and padding semantics; gradients via ``_scores_bwd``)."""
+    return _scores_pallas_vjp(Rs, ts, coords, pixels,
+                              jnp.float32(f), jnp.asarray(c, jnp.float32),
+                              tau, beta, interpret)

@@ -1,0 +1,66 @@
+"""Multi-process mesh test: the DCN story, exercised with 2 local processes.
+
+SURVEY.md §5 "distributed communication backend": the reference is single
+process; this framework's claim (PARALLELISM.md, parallel/multihost.py) is
+that its mesh + collectives are host-count agnostic.  Here 2 jax.distributed
+processes (Gloo collectives over localhost, 4 CPU devices each) drive one
+sharded ESAC loss+grad step over a (2-host data x 4-device expert) mesh via
+``tests/mp_worker.py``; both processes must report the same finite loss.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import re
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_sharded_esac_step():
+    port = _free_port()
+    env = dict(os.environ)
+    # The workers size their own CPU meshes (4 devices each); the suite's
+    # 8-virtual-device XLA_FLAGS must not leak in.
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(REPO / "tests" / "mp_worker.py"),
+             str(pid), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=REPO, env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=900)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(f"multi-process step timed out; partial output: {outs}")
+    for p, out in zip(procs, outs):
+        if p.returncode != 0 and "distributed" in out and "initialize" in out:
+            pytest.skip(f"jax.distributed unsupported here: {out[-500:]}")
+        assert p.returncode == 0, out[-2000:]
+    vals = [re.search(r"MP_OK loss=([-\d.einf]+) gnorm=([-\d.einf]+)", o)
+            for o in outs]
+    assert all(vals), outs
+    losses = [float(v.group(1)) for v in vals]
+    gnorms = [float(v.group(2)) for v in vals]
+    # Replicated out_specs: every process sees the same global loss.
+    assert losses[0] == pytest.approx(losses[1], rel=1e-6)
+    assert gnorms[0] == pytest.approx(gnorms[1], rel=1e-5)
