@@ -52,7 +52,25 @@ def common_parser(desc: str) -> argparse.ArgumentParser:
                         "invocation (0 = run to --iterations); --iterations "
                         "still sets the LR schedule, so a stopped+resumed run "
                         "reproduces the uninterrupted trajectory")
+    p.add_argument("--frames", type=int, default=0,
+                   help="synthetic scenes only: frames rendered per scene "
+                        "(0 = the SyntheticScene default; on-disk datasets "
+                        "have fixed frame counts and ignore this)")
+    p.add_argument("--res", type=int, nargs=2, default=None,
+                   metavar=("H", "W"),
+                   help="synthetic scenes only: render resolution "
+                        "(default 96 128; reference-scale runs use 192 256)")
     return p
+
+
+def scene_kwargs(args) -> dict:
+    """open_scene kwargs from the synthetic-scale flags (--frames/--res)."""
+    kw = {}
+    if getattr(args, "frames", 0):
+        kw["n_frames"] = args.frames
+    if getattr(args, "res", None):
+        kw["height"], kw["width"] = args.res
+    return kw
 
 
 def maybe_force_cpu(args) -> None:
@@ -103,4 +121,5 @@ __all__ = [
     "epoch_batches",
     "batch_frames",
     "open_scene",
+    "scene_kwargs",
 ]

@@ -25,6 +25,7 @@ inverts them once.
 from __future__ import annotations
 
 import pathlib
+import warnings
 from dataclasses import dataclass
 
 import jax
@@ -156,12 +157,17 @@ class SyntheticScene:
                 )
             )
         )
-        out = render(self.rvecs, self.tvecs)
+        # Chunked: one all-frames vmap spikes device memory at
+        # reference-scale --frames (the render's per-frame intermediates are
+        # materialized batch-wide); 64-frame chunks keep the peak flat.
+        imgs, crds = [], []
+        for i in range(0, n_frames, 64):
+            out = render(self.rvecs[i:i + 64], self.tvecs[i:i + 64])
+            imgs.append(np.asarray(out["image"], dtype=np.float32))
+            crds.append(np.asarray(out["coords_gt"], dtype=np.float32))
         h, w = height // coord_stride, width // coord_stride
-        self._images = np.asarray(out["image"], dtype=np.float32)
-        self._coords = np.asarray(out["coords_gt"], dtype=np.float32).reshape(
-            n_frames, h, w, 3
-        )
+        self._images = np.concatenate(imgs)
+        self._coords = np.concatenate(crds).reshape(n_frames, h, w, 3)
         self._rvecs = np.asarray(self.rvecs)
         self._tvecs = np.asarray(self.tvecs)
 
@@ -183,10 +189,19 @@ def open_scene(root: str, scene: str, split: str, expert: int | None = None, **k
     """Dispatch: ``synthN`` -> SyntheticScene, else on-disk SceneDataset.
 
     ``expert=None`` keeps each class's own default label (sid for synthetic
-    scenes, 0 on disk), matching direct construction.
+    scenes, 0 on disk), matching direct construction.  Synthetic-scale
+    kwargs (n_frames/height/width, from the CLI --frames/--res flags) are
+    meaningless for on-disk scenes — fixed frame counts and stored
+    resolutions — and are dropped with a warning there.
     """
     if scene.startswith("synth"):
         return SyntheticScene(scene, split, expert=expert, **kw)
+    dropped = [k for k in ("n_frames", "height", "width")
+               if kw.pop(k, None) is not None]
+    if dropped:
+        warnings.warn(
+            f"synthetic-scale kwargs {dropped} ignored for on-disk scene {scene!r}"
+        )
     return SceneDataset(root, scene, split, expert=expert or 0, **kw)
 
 

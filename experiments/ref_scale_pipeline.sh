@@ -1,0 +1,52 @@
+#!/bin/sh
+# Reference-scale config-#2 pipeline (BASELINE.md: gating + M experts) on the
+# real chip, through the REAL entry points -- the accuracy half of the
+# acceptance criteria at reference-like scale.
+#
+# 4 synthetic scenes (distinct textures), ref-size nets, 192x256 renders:
+#   stage 1: 4 experts x 12k iters   stage 2: gating 3k iters
+#   stage 3: end-to-end fine-tune    eval: test_esac.py, jax AND cpp backends
+#
+# WEDGE SAFETY: launch detached (setsid nohup sh experiments/ref_scale_pipeline.sh
+# > .ref_pipeline.log 2>&1 &) and NEVER kill it -- it owns the TPU while alive
+# (CLAUDE.md hazards).  Progress is line-buffered into the log.
+set -e
+cd "$(dirname "$0")/.."
+
+SCENES="synth0 synth1 synth2 synth3"
+EXPERTS="ckpt_ref_expert_synth0 ckpt_ref_expert_synth1 ckpt_ref_expert_synth2 ckpt_ref_expert_synth3"
+RES="192 256"
+
+echo "=== stage 1: experts ($(date)) ==="
+i=0
+for s in $SCENES; do
+  echo "--- expert $s ---"
+  python train_expert.py "$s" --size ref --frames 2048 --res $RES \
+    --iterations 12000 --learningrate 1e-3 --batch 8 \
+    --output "ckpt_ref_expert_$s"
+  i=$((i+1))
+done
+
+echo "=== stage 2: gating ($(date)) ==="
+python train_gating.py $SCENES --size ref --frames 1024 --res $RES \
+  --iterations 3000 --learningrate 1e-3 --batch 8 --output ckpt_ref_gating
+
+echo "=== eval before stage 3, jax backend ($(date)) ==="
+python test_esac.py $SCENES --size ref --frames 64 --res $RES \
+  --experts $EXPERTS --gating ckpt_ref_gating --hypotheses 256
+
+echo "=== stage 3: end-to-end ($(date)) ==="
+python train_esac.py $SCENES --size ref --frames 512 --res $RES \
+  --iterations 400 --learningrate 1e-5 --batch 2 --hypotheses 64 \
+  --experts $EXPERTS --gating ckpt_ref_gating --output ckpt_ref_esac
+
+E3="ckpt_ref_esac_expert0 ckpt_ref_esac_expert1 ckpt_ref_esac_expert2 ckpt_ref_esac_expert3"
+echo "=== eval after stage 3, jax backend ($(date)) ==="
+python test_esac.py $SCENES --size ref --frames 64 --res $RES \
+  --experts $E3 --gating ckpt_ref_esac_gating --hypotheses 256
+
+echo "=== eval after stage 3, cpp backend ($(date)) ==="
+python test_esac.py $SCENES --size ref --frames 64 --res $RES \
+  --experts $E3 --gating ckpt_ref_esac_gating --hypotheses 256 --backend cpp
+
+echo "=== pipeline done ($(date)) ==="

@@ -23,6 +23,7 @@ import numpy as np
 
 from esac_tpu.cli import (
     common_parser, make_expert, make_gating, maybe_force_cpu, open_scene,
+    scene_kwargs,
 )
 from esac_tpu.data.synthetic import output_pixel_grid
 from esac_tpu.geometry import pose_errors, rodrigues
@@ -48,7 +49,8 @@ def main(argv=None) -> int:
     maybe_force_cpu(args)
 
     datasets = [
-        open_scene(args.root, s, "test", expert=i) for i, s in enumerate(args.scenes)
+        open_scene(args.root, s, "test", expert=i, **scene_kwargs(args))
+        for i, s in enumerate(args.scenes)
     ]
     M = len(datasets)
     e_params, e_cfgs = [], []

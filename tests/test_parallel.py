@@ -170,7 +170,7 @@ def test_sharded_subsampled_scoring_uses_shared_cells():
         k_local = jax.random.fold_in(k_hyp, sid)
         _, _, sc = _per_expert_hypotheses(
             k_local, coords_all[sid:sid + 1], frame["pixels"], F, C, cfg,
-            inference=True, score_key=k_sub,
+            score_key=k_sub,
         )
         best_scores.append(float(jnp.max(sc)))
     assert int(expert) == int(np.argmax(best_scores)) == 4

@@ -25,6 +25,7 @@ import optax
 from esac_tpu.cli import (
     batch_frames, common_parser, make_expert, make_gating, maybe_force_cpu,
     open_scene,
+    scene_kwargs,
 )
 from esac_tpu.data.synthetic import output_pixel_grid
 from esac_tpu.geometry import rodrigues
@@ -54,7 +55,7 @@ def main(argv=None) -> int:
         p.error("need one --experts checkpoint per scene")
 
     datasets = [
-        open_scene(args.root, s, "training", expert=i)
+        open_scene(args.root, s, "training", expert=i, **scene_kwargs(args))
         for i, s in enumerate(args.scenes)
     ]
     M = len(datasets)

@@ -25,6 +25,7 @@ import optax
 from esac_tpu.cli import (
     batch_frames, common_parser, epoch_batches, make_expert, maybe_force_cpu,
     open_scene, scene_center_of,
+    scene_kwargs,
 )
 from esac_tpu.train import make_expert_train_step
 from esac_tpu.utils.checkpoint import load_train_state, save_train_state
@@ -39,7 +40,7 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     maybe_force_cpu(args)
 
-    ds = open_scene(args.root, args.scene, "training")
+    ds = open_scene(args.root, args.scene, "training", **scene_kwargs(args))
     center = scene_center_of(ds)
     net = make_expert(args.size, center)
 

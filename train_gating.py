@@ -19,6 +19,7 @@ import optax
 from esac_tpu.cli import (
     batch_frames, common_parser, epoch_batches, make_gating, maybe_force_cpu,
     open_scene,
+    scene_kwargs,
 )
 from esac_tpu.train import make_gating_train_step
 from esac_tpu.utils.checkpoint import load_train_state, save_train_state
@@ -32,7 +33,7 @@ def main(argv=None) -> int:
     maybe_force_cpu(args)
 
     datasets = [
-        open_scene(args.root, s, "training", expert=i)
+        open_scene(args.root, s, "training", expert=i, **scene_kwargs(args))
         for i, s in enumerate(args.scenes)
     ]
     M = len(datasets)
