@@ -52,6 +52,11 @@ def common_parser(desc: str) -> argparse.ArgumentParser:
                         "invocation (0 = run to --iterations); --iterations "
                         "still sets the LR schedule, so a stopped+resumed run "
                         "reproduces the uninterrupted trajectory")
+    p.add_argument("--checkpoint-every", type=int, default=0,
+                   help="also save the resume-capable train state every N "
+                        "iterations (0 = only at the end); long runs on "
+                        "remote accelerators should set this so a relay "
+                        "stall or preemption costs at most N iterations")
     p.add_argument("--frames", type=int, default=0,
                    help="synthetic scenes only: frames rendered per scene "
                         "(0 = the SyntheticScene default; on-disk datasets "

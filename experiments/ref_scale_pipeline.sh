@@ -10,6 +10,10 @@
 # WEDGE SAFETY: launch detached (setsid nohup sh experiments/ref_scale_pipeline.sh
 # > .ref_pipeline.log 2>&1 &) and NEVER kill it -- it owns the TPU while alive
 # (CLAUDE.md hazards).  Progress is line-buffered into the log.
+#
+# STALL SAFETY: every trainer passes --checkpoint-every, and a relaunch of
+# this script resumes each stage from its last periodic checkpoint (the
+# relay has been observed to freeze mid-run; CLAUDE.md hazards).
 set -e
 cd "$(dirname "$0")/.."
 
@@ -17,19 +21,25 @@ SCENES="synth0 synth1 synth2 synth3"
 EXPERTS="ckpt_ref_expert_synth0 ckpt_ref_expert_synth1 ckpt_ref_expert_synth2 ckpt_ref_expert_synth3"
 RES="192 256"
 
+# --resume only when a resume-capable checkpoint exists (first launch has none).
+resume_flag() {
+  if [ -d "$1/opt_state" ] || [ -d "$1.old/opt_state" ]; then echo "--resume"; fi
+  return 0
+}
+
 echo "=== stage 1: experts ($(date)) ==="
-i=0
 for s in $SCENES; do
+  ck="ckpt_ref_expert_$s"
   echo "--- expert $s ---"
   python train_expert.py "$s" --size ref --frames 2048 --res $RES \
     --iterations 12000 --learningrate 1e-3 --batch 8 \
-    --output "ckpt_ref_expert_$s"
-  i=$((i+1))
+    --checkpoint-every 2000 $(resume_flag "$ck") --output "$ck"
 done
 
 echo "=== stage 2: gating ($(date)) ==="
 python train_gating.py $SCENES --size ref --frames 1024 --res $RES \
-  --iterations 3000 --learningrate 1e-3 --batch 8 --output ckpt_ref_gating
+  --iterations 3000 --learningrate 1e-3 --batch 8 \
+  --checkpoint-every 1000 $(resume_flag ckpt_ref_gating) --output ckpt_ref_gating
 
 echo "=== eval before stage 3, jax backend ($(date)) ==="
 python test_esac.py $SCENES --size ref --frames 64 --res $RES \
@@ -38,6 +48,7 @@ python test_esac.py $SCENES --size ref --frames 64 --res $RES \
 echo "=== stage 3: end-to-end ($(date)) ==="
 python train_esac.py $SCENES --size ref --frames 512 --res $RES \
   --iterations 400 --learningrate 1e-5 --batch 2 --hypotheses 64 \
+  --checkpoint-every 100 $(resume_flag ckpt_ref_esac_state) \
   --experts $EXPERTS --gating ckpt_ref_gating --output ckpt_ref_esac
 
 E3="ckpt_ref_esac_expert0 ckpt_ref_esac_expert1 ckpt_ref_esac_expert2 ckpt_ref_esac_expert3"

@@ -143,6 +143,49 @@ def test_kill_and_resume_matches_uninterrupted(tmp_path):
         np.testing.assert_allclose(a, b, atol=1e-6)
 
 
+def test_periodic_checkpointing(tmp_path):
+    """--checkpoint-every N writes resume-capable state mid-run (the relay
+    can stall mid-training — CLAUDE.md hazards — so long runs must not lose
+    everything); the final save still lands at --iterations."""
+    import subprocess
+    import sys
+
+    from esac_tpu.utils.checkpoint import load_checkpoint
+
+    repo = pathlib.Path(__file__).parent.parent
+    r = subprocess.run(
+        [sys.executable, str(repo / "train_expert.py"), "synth0", "--cpu",
+         "--size", "test", "--batch", "2", "--iterations", "6",
+         "--checkpoint-every", "2", "--output", str(tmp_path / "ck")],
+        capture_output=True, text=True, cwd=repo, timeout=600, check=True,
+    )
+    assert "@ iter 2" in r.stdout and "@ iter 4" in r.stdout
+    # No redundant periodic save at the final iteration (the end save covers it).
+    assert "@ iter 6" not in r.stdout
+    assert load_checkpoint(tmp_path / "ck")[1]["iteration"] == 6
+
+
+def test_train_state_old_fallback(tmp_path):
+    """Death between save_train_state's two renames leaves the previous
+    checkpoint at <path>.old; load_train_state must fall back to it."""
+    import optax
+
+    from esac_tpu.utils.checkpoint import load_train_state, save_train_state
+
+    net = ExpertNet(stem_channels=(4, 8, 8), head_channels=8, head_depth=1,
+                    compute_dtype=jnp.float32)
+    x = jnp.ones((1, 16, 16, 3))
+    params = net.init(jax.random.key(0), x)
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+    save_train_state(tmp_path / "ck", params, {"kind": "expert"}, opt_state, 5)
+    # Simulate the rename window: the new dir vanished, .old remains.
+    (tmp_path / "ck").rename(tmp_path / "ck.old")
+    with pytest.warns(UserWarning, match="ck.old"):
+        _, _, cfg, it = load_train_state(tmp_path / "ck", opt_state)
+    assert it == 5 and cfg["kind"] == "expert"
+
+
 def test_gating_resume_roundtrip(tmp_path):
     """Gating trainer: stop/resume preserves optimizer state (smoke)."""
     import subprocess

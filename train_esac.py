@@ -176,14 +176,19 @@ def main(argv=None) -> int:
             print(f"iter {it:6d}  E[pose loss] {float(loss):.3f}  "
                   f"({time.time() - t0:.0f}s)", flush=True)
         last_it = it + 1
+        if (args.checkpoint_every and last_it % args.checkpoint_every == 0
+                and last_it < args.iterations):
+            save_train_state(f"{args.output}_state", params,
+                             {"kind": "esac_state", "scenes": args.scenes},
+                             opt_state, iteration=last_it)
+            print(f"checkpoint {args.output}_state @ iter {last_it}", flush=True)
         if args.stop_after and last_it - start_it >= args.stop_after:
             break
 
     e_stack, g_params = params
-    save_train_state(f"{args.output}_state", params, {
-        "kind": "esac_state",
-        "scenes": args.scenes,
-    }, opt_state, iteration=last_it)
+    save_train_state(f"{args.output}_state", params,
+                     {"kind": "esac_state", "scenes": args.scenes},
+                     opt_state, iteration=last_it)
     for m, cfg_d in enumerate(e_cfgs):
         cfg_d["e2e"] = True
         save_checkpoint(

@@ -107,18 +107,28 @@ def main(argv=None) -> int:
             print(f"iter {it:7d}  coord L1 {float(loss):.4f}  "
                   f"({(time.time() - t0):.0f}s)", flush=True)
         last_it = it + 1
+        if (args.checkpoint_every and last_it % args.checkpoint_every == 0
+                and last_it < args.iterations):
+            save_train_state(out, params, _ck_config(args, center, loss),
+                             opt_state, iteration=last_it)
+            print(f"checkpoint {out} @ iter {last_it}", flush=True)
         if args.stop_after and last_it - start_it >= args.stop_after:
             break
 
-    save_train_state(out, params, {
+    save_train_state(out, params, _ck_config(args, center, loss),
+                     opt_state, iteration=last_it)
+    print(f"saved {out}  final coord L1 {float(loss):.4f}")
+    return 0
+
+
+def _ck_config(args, center, loss) -> dict:
+    return {
         "kind": "expert",
         "size": args.size,
         "scene": args.scene,
         "scene_center": [float(x) for x in center],
         "final_loss": float(loss),
-    }, opt_state, iteration=last_it)
-    print(f"saved {out}  final coord L1 {float(loss):.4f}")
-    return 0
+    }
 
 
 if __name__ == "__main__":

@@ -70,17 +70,27 @@ def main(argv=None) -> int:
             print(f"iter {it:7d}  CE {float(loss):.4f}  ({time.time() - t0:.0f}s)",
                   flush=True)
         last_it = it + 1
+        if (args.checkpoint_every and last_it % args.checkpoint_every == 0
+                and last_it < args.iterations):
+            save_train_state(args.output, params, _ck_config(args, loss),
+                             opt_state, iteration=last_it)
+            print(f"checkpoint {args.output} @ iter {last_it}", flush=True)
         if args.stop_after and last_it - start_it >= args.stop_after:
             break
 
-    save_train_state(args.output, params, {
+    save_train_state(args.output, params, _ck_config(args, loss),
+                     opt_state, iteration=last_it)
+    print(f"saved {args.output}  final CE {float(loss):.4f}")
+    return 0
+
+
+def _ck_config(args, loss) -> dict:
+    return {
         "kind": "gating",
         "size": args.size,
         "scenes": args.scenes,
         "final_loss": float(loss),
-    }, opt_state, iteration=last_it)
-    print(f"saved {args.output}  final CE {float(loss):.4f}")
-    return 0
+    }
 
 
 if __name__ == "__main__":
