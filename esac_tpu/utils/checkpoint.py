@@ -50,6 +50,12 @@ def save_train_state(path: str | pathlib.Path, params: Any, config: dict,
     ``<path>.old`` and ``load_train_state`` falls back to it.
     """
     path = pathlib.Path(path).absolute()
+    old = path.with_name(path.name + ".old")
+    if not path.exists() and old.exists():
+        # Repair a previous crash-between-renames BEFORE deleting anything:
+        # .old is the only surviving state and must never be removed while
+        # no checkpoint exists at path.
+        os.rename(old, path)
     staging = path.with_name(path.name + ".staging")
     if staging.exists():
         shutil.rmtree(staging)
@@ -60,9 +66,8 @@ def save_train_state(path: str | pathlib.Path, params: Any, config: dict,
     (staging / "config.json").write_text(
         json.dumps({**config, "iteration": int(iteration)}, indent=2)
     )
-    old = path.with_name(path.name + ".old")
     if old.exists():
-        shutil.rmtree(old)
+        shutil.rmtree(old)  # safe: a complete checkpoint exists at path
     if path.exists():
         os.rename(path, old)
     os.rename(staging, path)

@@ -1,0 +1,92 @@
+"""Per-stage timing of the hypothesis pipeline on the current backend.
+
+Answers TODO #3's "profile first": is the minimal solve worth a fused
+Pallas kernel, or does scoring dominate?  Each stage is isolated into its
+own jitted function at BASELINE.md config #1 shapes (batch 16 x 256 hyps,
+4800 cells) and fenced with block_until_ready.  Writes one JSON line:
+
+  {"sample_solve_ms": ..., "score_ms": ..., "refine_ms": ...,
+   "full_ms": ..., "device_kind": ...}
+
+CPU-safe (runs anywhere); meaningful numbers need the real chip.  Launch
+detached on TPU (CLAUDE.md wedge hazards).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+BATCH, N_HYPS = 16, 256
+
+
+def _ms(fn, args, repeats=20) -> float:
+    import jax
+
+    jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / repeats * 1e3
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.data import CAMERA_F, make_correspondence_frame
+    from esac_tpu.ransac import RansacConfig, dsac_infer
+    from esac_tpu.ransac.kernel import _score_hypotheses, generate_hypotheses
+    from esac_tpu.ransac.refine import refine_soft_inliers
+
+    cfg = RansacConfig(n_hyps=N_HYPS)
+    f32 = jnp.float32(CAMERA_F)
+    c = jnp.asarray([320.0, 240.0])
+    keys = jax.random.split(jax.random.key(0), BATCH)
+    frames = [make_correspondence_frame(k, noise=0.01, outlier_frac=0.3)
+              for k in keys]
+    coords = jnp.stack([f["coords"] for f in frames])
+    pixels = jnp.stack([f["pixels"] for f in frames])
+    rkeys = jax.random.split(jax.random.key(1), BATCH)
+
+    gen = jax.jit(jax.vmap(
+        lambda k, co, px: generate_hypotheses(k, co, px, f32, c, cfg)
+    ))
+    rvs, tvs = gen(rkeys, coords, pixels)
+
+    score = jax.jit(jax.vmap(
+        lambda k, rv, tv, co, px: _score_hypotheses(k, rv, tv, co, px, f32, c, cfg)
+    ))
+    scores = score(rkeys, rvs, tvs, coords, pixels)
+
+    refine = jax.jit(jax.vmap(
+        lambda rv, tv, co, px: refine_soft_inliers(
+            rv, tv, co, px, f32, c, cfg.tau, cfg.beta, iters=cfg.refine_iters)
+    ))
+    best = jnp.argmax(scores, axis=1)
+    rb = jnp.take_along_axis(rvs, best[:, None, None], 1)[:, 0]
+    tb = jnp.take_along_axis(tvs, best[:, None, None], 1)[:, 0]
+
+    full = jax.jit(jax.vmap(
+        lambda k, co, px: dsac_infer(k, co, px, f32, c, cfg)["rvec"]
+    ))
+
+    res = {
+        "sample_solve_ms": round(_ms(gen, (rkeys, coords, pixels)), 3),
+        "score_ms": round(_ms(score, (rkeys, rvs, tvs, coords, pixels)), 3),
+        "refine_ms": round(_ms(refine, (rb, tb, coords, pixels)), 3),
+        "full_ms": round(_ms(full, (rkeys, coords, pixels)), 3),
+        "batch": BATCH, "n_hyps": N_HYPS,
+        "device_kind": jax.devices()[0].device_kind,
+        "platform": jax.devices()[0].platform,
+    }
+    line = json.dumps(res)
+    (REPO / ".profile_stages.json").write_text(line)
+    print(line, flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -186,6 +186,29 @@ def test_train_state_old_fallback(tmp_path):
     assert it == 5 and cfg["kind"] == "expert"
 
 
+def test_train_state_save_repairs_crash_state(tmp_path):
+    """save_train_state after a crash-between-renames (path missing, .old
+    present) must repair FIRST — never delete .old while it is the only
+    surviving checkpoint — and end with a complete checkpoint, no .old."""
+    import optax
+
+    from esac_tpu.utils.checkpoint import load_train_state, save_train_state
+
+    net = ExpertNet(stem_channels=(4, 8, 8), head_channels=8, head_depth=1,
+                    compute_dtype=jnp.float32)
+    x = jnp.ones((1, 16, 16, 3))
+    params = net.init(jax.random.key(0), x)
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+    save_train_state(tmp_path / "ck", params, {"k": 1}, opt_state, 3)
+    (tmp_path / "ck").rename(tmp_path / "ck.old")  # crash window state
+    save_train_state(tmp_path / "ck", params, {"k": 2}, opt_state, 4)
+    assert not (tmp_path / "ck.old").exists()
+    assert not (tmp_path / "ck.staging").exists()
+    _, _, cfg, it = load_train_state(tmp_path / "ck", opt_state)
+    assert it == 4 and cfg["k"] == 2
+
+
 def test_gating_resume_roundtrip(tmp_path):
     """Gating trainer: stop/resume preserves optimizer state (smoke)."""
     import subprocess

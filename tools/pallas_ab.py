@@ -1,0 +1,99 @@
+"""A/B the fused Pallas scoring kernel vs the XLA path on the real chip.
+
+VERDICT round-1 item #5: measure use_pallas_scoring=True vs False on
+hardware and record the result; the default flips only on a measured win.
+Writes ONE JSON line to stdout and to .pallas_ab.json:
+
+  {"xla_hyps_per_sec": ..., "pallas_hyps_per_sec": ..., "speedup": ...,
+   "max_abs_score_diff": ..., "device_kind": ...}
+
+Runs the full dsac_infer pipeline both ways (the kernel sits in the scoring
+slot) plus a scoring-only microbench, at BASELINE.md config #1 shapes.
+Launch detached (wedge safety, CLAUDE.md): never kill this process.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+N_HYPS = 256
+BATCH = 16
+REPEATS = 30
+
+
+def _rate(fn, args, n_hyps_total: int, repeats: int = REPEATS) -> float:
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return repeats * n_hyps_total / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.data import CAMERA_F, make_correspondence_frame
+    from esac_tpu.geometry.rotations import rodrigues
+    from esac_tpu.ransac import RansacConfig, dsac_infer
+    from esac_tpu.ransac.kernel import generate_hypotheses
+    from esac_tpu.ransac.pallas_scoring import soft_inlier_scores_pallas
+    from esac_tpu.ransac.scoring import reprojection_error_map, soft_inlier_score
+
+    f32 = jnp.float32(CAMERA_F)
+    c = jnp.asarray([320.0, 240.0])
+    keys = jax.random.split(jax.random.key(0), BATCH)
+    frames = [make_correspondence_frame(k, noise=0.01, outlier_frac=0.3)
+              for k in keys]
+    coords = jnp.stack([f["coords"] for f in frames])
+    pixels = jnp.stack([f["pixels"] for f in frames])
+    rkeys = jax.random.split(jax.random.key(1), BATCH)
+
+    res = {"device_kind": jax.devices()[0].device_kind,
+           "platform": jax.devices()[0].platform}
+
+    # Full-pipeline A/B.
+    for name, flag in (("xla", False), ("pallas", True)):
+        cfg = RansacConfig(n_hyps=N_HYPS, use_pallas_scoring=flag)
+        fn = jax.jit(jax.vmap(
+            lambda k, co, px: dsac_infer(k, co, px, f32, c, cfg)["rvec"]
+        ))
+        res[f"{name}_hyps_per_sec"] = round(
+            _rate(fn, (rkeys, coords, pixels), BATCH * N_HYPS), 1
+        )
+    res["speedup"] = round(res["pallas_hyps_per_sec"] / res["xla_hyps_per_sec"], 3)
+
+    # Scoring-only microbench + numeric agreement on hardware.
+    cfg = RansacConfig(n_hyps=N_HYPS)
+    rv, tv = generate_hypotheses(jax.random.key(2), coords[0], pixels[0], f32, c, cfg)
+    Rs = jax.vmap(rodrigues)(rv)
+
+    interp = jax.default_backend() != "tpu"  # same fallback dsac_infer uses
+    # Operands are ARGUMENTS, not closed-over constants: a nullary jit over
+    # constants invites HLO constant folding of the XLA variant (the Pallas
+    # custom call can't fold), which would skew exactly this A/B.
+    score_xla = jax.jit(lambda rv_, tv_, co_, px_: soft_inlier_score(
+        reprojection_error_map(rv_, tv_, co_, px_, f32, c), 10.0, 0.5))
+    score_pal = jax.jit(lambda Rs_, tv_, co_, px_: soft_inlier_scores_pallas(
+        Rs_, tv_, co_, px_, f32, c, 10.0, 0.5, interpret=interp))
+    xa = (rv, tv, coords[0], pixels[0])
+    pa = (Rs, tv, coords[0], pixels[0])
+    a, b = score_xla(*xa), score_pal(*pa)
+    res["max_abs_score_diff"] = float(jnp.max(jnp.abs(a - b)))
+    res["scoring_only_xla"] = round(_rate(score_xla, xa, N_HYPS), 1)
+    res["scoring_only_pallas"] = round(_rate(score_pal, pa, N_HYPS), 1)
+
+    line = json.dumps(res)
+    (REPO / ".pallas_ab.json").write_text(line)
+    print(line, flush=True)
+
+
+if __name__ == "__main__":
+    main()

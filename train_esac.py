@@ -165,9 +165,10 @@ def main(argv=None) -> int:
     loss = float("nan")
     last_it = start_it
     for it in range(args.iterations):
-        idx = jnp.asarray(rng.integers(0, images_d.shape[0], size=args.batch))
+        idx = rng.integers(0, images_d.shape[0], size=args.batch)
         if it < start_it:  # fast-forward the data stream on resume
             continue
+        idx = jnp.asarray(idx)
         params, opt_state, loss = train_step(
             params, opt_state, jax.random.key(args.seed * 7919 + it),
             images_d[idx], R_gts_d[idx], tvecs_d[idx], focal,
@@ -185,6 +186,10 @@ def main(argv=None) -> int:
         if args.stop_after and last_it - start_it >= args.stop_after:
             break
 
+    if last_it == start_it:
+        print(f"{args.output}_state already at iteration {last_it}; "
+              "nothing to do")
+        return 0
     e_stack, g_params = params
     save_train_state(f"{args.output}_state", params,
                      {"kind": "esac_state", "scenes": args.scenes},

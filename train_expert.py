@@ -115,6 +115,11 @@ def main(argv=None) -> int:
         if args.stop_after and last_it - start_it >= args.stop_after:
             break
 
+    if last_it == start_it:
+        # Resume of an already-complete run: zero steps executed, loss is
+        # NaN — re-saving would clobber the checkpoint's real final_loss.
+        print(f"{out} already at iteration {last_it}; nothing to do")
+        return 0
     save_train_state(out, params, _ck_config(args, center, loss),
                      opt_state, iteration=last_it)
     print(f"saved {out}  final coord L1 {float(loss):.4f}")

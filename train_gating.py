@@ -62,9 +62,10 @@ def main(argv=None) -> int:
     loss = float("nan")
     last_it = start_it
     for it in range(args.iterations):
-        idx = jnp.asarray(rng.integers(0, images_d.shape[0], size=args.batch))
+        idx = rng.integers(0, images_d.shape[0], size=args.batch)
         if it < start_it:  # fast-forward the data stream on resume
             continue
+        idx = jnp.asarray(idx)
         params, opt_state, loss = step(params, opt_state, images_d[idx], labels_d[idx])
         if it % max(1, args.iterations // 20) == 0:
             print(f"iter {it:7d}  CE {float(loss):.4f}  ({time.time() - t0:.0f}s)",
@@ -78,6 +79,9 @@ def main(argv=None) -> int:
         if args.stop_after and last_it - start_it >= args.stop_after:
             break
 
+    if last_it == start_it:
+        print(f"{args.output} already at iteration {last_it}; nothing to do")
+        return 0
     save_train_state(args.output, params, _ck_config(args, loss),
                      opt_state, iteration=last_it)
     print(f"saved {args.output}  final CE {float(loss):.4f}")
