@@ -228,6 +228,24 @@ def measure_on_device(
 ) -> dict | None:
     """Run _measure_jax on the real device via a detached child; None on
     failure.  The child is never killed: on deadline it is left orphaned."""
+    # Another sanctioned TPU job (tools/chip_recovery.sh's queue) may own the
+    # chip; wait for its .tpu_busy sentinel rather than becoming a second
+    # concurrent client.  A stale sentinel (owner dead) is ignored.
+    busy = _REPO / ".tpu_busy"
+    wait_deadline = time.time() + 3600
+    while busy.exists():
+        try:
+            owner = int(busy.read_text().strip())
+        except Exception:
+            owner = None
+        if owner is not None and not _pid_running(owner):
+            break  # stale sentinel: owner died without cleanup
+        if time.time() >= wait_deadline:
+            # Owner still alive and working: becoming a second concurrent
+            # TPU client is the one thing this sentinel exists to prevent —
+            # fall back to CPU instead.
+            return None
+        time.sleep(15.0)
     alive, reason = relay_alive()
     if not alive:
         return None

@@ -100,15 +100,24 @@ def make_gating(size: str, num_experts: int, dtype=None) -> GatingNet:
 def scene_center_of(ds, n_probe: int = 8) -> np.ndarray:
     """Mean GT scene coordinate over a few frames (the per-scene offset the
     expert regresses around, as the reference initializes with the scene
-    translation)."""
-    cs = []
+    translation).  Scenes without GT coords (the outdoor/no-depth path)
+    fall back to the mean camera center, the only scene-frame anchor the
+    pose list provides."""
+    cs, cams = [], []
     for i in np.linspace(0, len(ds) - 1, min(n_probe, len(ds))).astype(int):
         f = ds[int(i)]
         if f.coords_gt is not None:
             cs.append(f.coords_gt.reshape(-1, 3).mean(axis=0))
-    if not cs:
-        return np.zeros(3, dtype=np.float32)
-    return np.stack(cs).mean(axis=0)
+        else:
+            from esac_tpu.geometry import rodrigues
+
+            R = np.asarray(rodrigues(jnp.asarray(f.rvec)))
+            cams.append(-R.T @ np.asarray(f.tvec))
+    if cs:
+        return np.stack(cs).mean(axis=0)
+    if cams:
+        return np.stack(cams).mean(axis=0).astype(np.float32)
+    return np.zeros(3, dtype=np.float32)
 
 
 def epoch_batches(rng: np.random.Generator, n: int, batch: int):

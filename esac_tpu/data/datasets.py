@@ -214,6 +214,10 @@ def batch_frames(ds, idx: np.ndarray) -> dict:
         "tvecs": jnp.stack([jnp.asarray(f.tvec) for f in frames]),
         "labels": jnp.asarray([f.expert for f in frames]),
         "focal": frames[0].focal,
+        # Per-frame intrinsics: outdoor datasets mix cameras, so consumers
+        # that project (the reproj stage-1 loss) must not assume frame 0's
+        # focal for the whole batch.
+        "focals": jnp.asarray([f.focal for f in frames], jnp.float32),
     }
     if frames[0].coords_gt is not None:
         out["coords_gt"] = jnp.stack([jnp.asarray(f.coords_gt) for f in frames])

@@ -15,6 +15,7 @@ from esac_tpu.geometry.camera import (
     transform_points,
     project,
     reprojection_errors,
+    backproject_at_depth,
     pose_errors,
 )
 from esac_tpu.geometry.pnp import (
@@ -31,6 +32,7 @@ __all__ = [
     "transform_points",
     "project",
     "reprojection_errors",
+    "backproject_at_depth",
     "pose_errors",
     "solve_pnp_minimal",
     "refine_pose_gn",

@@ -72,6 +72,33 @@ def reprojection_errors(
     return jnp.where(behind, err + 1000.0, err)
 
 
+def backproject_at_depth(
+    R: jnp.ndarray,
+    t: jnp.ndarray,
+    pixels: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    depth: jnp.ndarray,
+) -> jnp.ndarray:
+    """Scene points observed at a constant camera-frame depth.
+
+    The heuristic stage-1 init target for scenes WITHOUT depth GT — the
+    reference's outdoor (Aachen) recipe initializes experts against targets
+    back-projected at a constant depth along each pixel ray (SURVEY.md §0
+    training stage 1, §2 #15 "heuristic-depth targets").
+
+    R (..., 3, 3) / t (..., 3): scene->camera pose (as everywhere in
+    esac_tpu.geometry); pixels (N, 2); depth: scalar meters.
+    Returns (..., N, 3) scene-frame points: X = R^T (Y - t) with
+    Y = depth * ray(pixel).
+    """
+    xy = (pixels - c) / f
+    Y = jnp.concatenate(
+        [xy * depth, jnp.full_like(xy[..., :1], depth)], axis=-1
+    )
+    return hmm(Y - t[..., None, :], R)  # row-vector form of R^T (Y - t)
+
+
 def pose_errors(
     R: jnp.ndarray,
     t: jnp.ndarray,

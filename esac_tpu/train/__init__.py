@@ -10,12 +10,16 @@ All steps are pure jitted functions over (params, opt_state, batch); entry
 scripts at the repo root provide the reference-compatible CLI.
 """
 
-from esac_tpu.train.expert import make_expert_train_step
+from esac_tpu.train.expert import (
+    make_expert_reproj_train_step, make_expert_train_step, reprojection_loss,
+)
 from esac_tpu.train.gating import make_gating_train_step
 from esac_tpu.train.e2e import make_dsac_train_step
 
 __all__ = [
+    "make_expert_reproj_train_step",
     "make_expert_train_step",
+    "reprojection_loss",
     "make_gating_train_step",
     "make_dsac_train_step",
 ]

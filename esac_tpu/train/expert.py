@@ -1,8 +1,10 @@
 """Stage-1 expert training: scene-coordinate regression.
 
 Reference counterpart: ``train_expert.py`` hot loop (SURVEY.md §3.1):
-image -> expert forward -> masked L1 against GT coordinates (or clamped
-reprojection error when no depth GT exists) -> Adam step.
+image -> expert forward -> masked L1 against GT coordinates, or — for
+scenes without depth GT (the outdoor/Aachen path, SURVEY.md §0 stage 1) —
+clamped reprojection error against the GT pose, bootstrapped from
+heuristic constant-depth targets (``geometry.backproject_at_depth``).
 """
 
 from __future__ import annotations
@@ -13,6 +15,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from esac_tpu.geometry.camera import reprojection_errors
+from esac_tpu.geometry.rotations import rodrigues
 from esac_tpu.models.expert import coordinate_loss
 
 
@@ -31,6 +35,61 @@ def make_expert_train_step(
         def loss_fn(p):
             pred = net.apply(p, images)
             return coordinate_loss(pred, targets, masks)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def reprojection_loss(
+    pred: jnp.ndarray,
+    rvecs: jnp.ndarray,
+    tvecs: jnp.ndarray,
+    pixels: jnp.ndarray,
+    fs: jnp.ndarray,
+    c: jnp.ndarray,
+    clamp_px: float = 100.0,
+) -> jnp.ndarray:
+    """Mean clamped reprojection error of predicted scene coordinates.
+
+    The stage-1 loss when no depth GT exists (SURVEY.md §3.1): every output
+    cell's predicted 3D point is projected through the GT pose and penalized
+    by its pixel distance to the cell center, clamped so gross outliers
+    (inevitable early in outdoor training) cannot dominate the gradient.
+
+    pred: (B, h, w, 3) or (B, N, 3); rvecs/tvecs: (B, 3); pixels: (N, 2);
+    fs: scalar or (B,) focal lengths — outdoor datasets carry per-frame
+    intrinsics, so the focal is batched alongside the poses.
+    """
+    B = pred.shape[0]
+    coords = pred.reshape(B, -1, 3)
+    Rs = jax.vmap(rodrigues)(rvecs)
+    fs = jnp.broadcast_to(jnp.asarray(fs, coords.dtype), (B,))
+    errs = jax.vmap(
+        lambda R, t, co, f: reprojection_errors(R, t, co, pixels, f, c)
+    )(Rs, tvecs, coords, fs)
+    return jnp.mean(jnp.minimum(errs, clamp_px))
+
+
+def make_expert_reproj_train_step(
+    net,
+    optimizer: optax.GradientTransformation,
+    pixels: jnp.ndarray,
+    c: jnp.ndarray,
+    clamp_px: float = 100.0,
+) -> Callable:
+    """Returns jitted ``step(params, opt_state, images, rvecs, tvecs, fs)``
+    minimizing ``reprojection_loss`` — the no-depth-GT stage-1 mode.
+    ``fs``: (B,) per-frame focal lengths."""
+
+    @jax.jit
+    def step(params, opt_state, images, rvecs, tvecs, fs):
+        def loss_fn(p):
+            pred = net.apply(p, images)
+            return reprojection_loss(pred, rvecs, tvecs, pixels, fs, c, clamp_px)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)

@@ -1,0 +1,133 @@
+"""Stage-1 reprojection-loss mode: the outdoor/no-depth-GT path.
+
+SURVEY.md §0 stage 1 / §2 #9: when a scene has no depth GT (Aachen), the
+reference initializes experts against heuristic constant-depth targets and
+trains with a (clamped) reprojection loss against the GT pose.
+"""
+
+import subprocess
+import sys
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from esac_tpu.data import CAMERA_F, make_correspondence_frame
+from esac_tpu.data.synthetic import output_pixel_grid
+from esac_tpu.geometry import backproject_at_depth, rodrigues
+from esac_tpu.train import reprojection_loss
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_backproject_at_depth_roundtrip():
+    """Back-projected points must reproject to their pixels with the given
+    camera depth under the same pose."""
+    from esac_tpu.geometry import project, transform_points
+
+    rvec = jnp.asarray([0.2, -0.1, 0.3])
+    tvec = jnp.asarray([0.5, -0.2, 1.0])
+    R = rodrigues(rvec)
+    pixels = output_pixel_grid(96, 128, 8)
+    f = jnp.float32(100.0)
+    c = jnp.asarray([64.0, 48.0])
+    X = backproject_at_depth(R, tvec, pixels, f, c, 4.0)
+    Y = transform_points(R, tvec, X)
+    np.testing.assert_allclose(np.asarray(Y[:, 2]), 4.0, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(project(Y, f, c)),
+                               np.asarray(pixels), atol=1e-3)
+
+
+def test_reprojection_loss_zero_at_gt():
+    """GT scene coordinates have (near) zero reprojection loss; perturbed
+    ones have more, and the gradient is finite and nonzero."""
+    frame = make_correspondence_frame(jax.random.key(0), noise=0.0,
+                                      outlier_frac=0.0)
+    f = jnp.float32(CAMERA_F)
+    c = jnp.asarray([320.0, 240.0])
+    pred = frame["coords"][None]
+    rv, tv = frame["rvec"][None], frame["tvec"][None]
+    l0 = reprojection_loss(pred, rv, tv, frame["pixels"], f, c)
+    l1 = reprojection_loss(pred + 0.05, rv, tv, frame["pixels"], f, c)
+    assert float(l0) < 0.5 < float(l1)
+    g = jax.grad(lambda p: reprojection_loss(p, rv, tv, frame["pixels"], f, c))(pred)
+    assert jnp.all(jnp.isfinite(g)) and jnp.any(g != 0)
+
+
+def test_cli_reproj_mode_trains(tmp_path):
+    """train_expert --loss reproj end-to-end on a synthetic scene (forcing
+    the no-coords path); loss decreases and the checkpoint records the mode."""
+    from esac_tpu.utils.checkpoint import load_checkpoint
+
+    r = subprocess.run(
+        [sys.executable, str(REPO / "train_expert.py"), "synth0", "--cpu",
+         "--size", "test", "--batch", "2", "--iterations", "40",
+         "--learningrate", "1e-3", "--loss", "reproj", "--init-iters", "20",
+         "--init-depth", "4.0", "--output", str(tmp_path / "ck")],
+        capture_output=True, text=True, cwd=REPO, timeout=900,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "init L1" in r.stdout and "reproj px" in r.stdout
+    _, cfg = load_checkpoint(tmp_path / "ck")
+    assert cfg["loss_mode"] == "reproj"
+    assert np.isfinite(cfg["final_loss"])
+
+
+def test_reprojection_loss_per_frame_focals():
+    """Outdoor batches mix cameras: reprojection_loss must honor per-frame
+    focal lengths, not broadcast frame 0's."""
+    frame = make_correspondence_frame(jax.random.key(1), noise=0.0,
+                                      outlier_frac=0.0)
+    c = jnp.asarray([320.0, 240.0])
+    pred = jnp.stack([frame["coords"], frame["coords"]])
+    rv = jnp.stack([frame["rvec"]] * 2)
+    tv = jnp.stack([frame["tvec"]] * 2)
+    px = frame["pixels"]
+    # Frame 1 rendered with CAMERA_F but scored at half focal: large error.
+    fs = jnp.asarray([CAMERA_F, CAMERA_F / 2.0])
+    mixed = reprojection_loss(pred, rv, tv, px, fs, c)
+    uniform = reprojection_loss(pred, rv, tv, px, jnp.float32(CAMERA_F), c)
+    assert float(uniform) < 0.5          # both frames consistent
+    assert float(mixed) > float(uniform) + 1.0  # frame 1's focal mattered
+
+
+def test_cli_auto_mode_on_diskscene_without_depth(tmp_path):
+    """An on-disk scene with poses but NO depth/init (the Aachen layout
+    after setup) auto-selects reprojection mode and trains."""
+    from PIL import Image
+
+    from esac_tpu.utils.checkpoint import load_checkpoint
+
+    scene = tmp_path / "data" / "outdoor" / "training"
+    for sub in ("rgb", "poses", "calibration"):
+        (scene / sub).mkdir(parents=True)
+    rng = np.random.default_rng(0)
+    for i in range(3):
+        Image.fromarray(
+            rng.integers(0, 255, (48, 64, 3), dtype=np.uint8), "RGB"
+        ).save(scene / "rgb" / f"f{i}.png")
+        T = np.eye(4)
+        T[:3, 3] = [0.1 * i, 0.0, -2.0]  # camera-to-scene
+        np.savetxt(scene / "poses" / f"f{i}.txt", T)
+        np.savetxt(scene / "calibration" / f"f{i}.txt", [60.0])
+    r = subprocess.run(
+        [sys.executable, str(REPO / "train_expert.py"), "outdoor", "--cpu",
+         "--root", str(tmp_path / "data"), "--size", "test", "--batch", "2",
+         "--iterations", "6", "--init-iters", "3",
+         "--output", str(tmp_path / "ck")],
+        capture_output=True, text=True, cwd=REPO, timeout=900,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "init L1" in r.stdout
+    assert load_checkpoint(tmp_path / "ck")[1]["loss_mode"] == "reproj"
+
+
+def test_cli_rejects_reproj_plus_augment():
+    r = subprocess.run(
+        [sys.executable, str(REPO / "train_expert.py"), "synth0", "--cpu",
+         "--size", "test", "--iterations", "2", "--loss", "reproj",
+         "--augment", "--output", "/tmp/never"],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert r.returncode != 0 and "augment" in r.stderr

@@ -49,7 +49,14 @@ while : ; do
 done
 
 echo "=== relay healthy ($(date)) — running queued TPU jobs ==="
+# .tpu_busy tells other would-be TPU clients (bench.py's device measurement,
+# i.e. the driver's end-of-round run) to wait instead of colliding with the
+# jobs below.  Always removed on exit, even if a job fails.
+echo $$ > .tpu_busy
+trap 'rm -f .tpu_busy' EXIT
+trap 'rm -f .tpu_busy; exit 130' INT TERM
 python tools/pallas_ab.py || echo "pallas_ab failed rc=$?"
 python experiments/profile_stages.py || echo "profile_stages failed rc=$?"
 sh experiments/ref_scale_pipeline.sh
+rm -f .tpu_busy
 echo "=== chip recovery runbook done ($(date)) ==="

@@ -37,12 +37,33 @@ def main(argv=None) -> int:
     p.add_argument("--output", default=None, help="checkpoint directory")
     p.add_argument("--augment", action="store_true",
                    help="rotation/scale/brightness augmentation (see data/augment.py)")
+    p.add_argument("--loss", choices=("auto", "coords", "reproj"),
+                   default="auto",
+                   help="stage-1 loss: masked-L1 to GT coordinates, or "
+                        "clamped reprojection error for scenes without "
+                        "depth GT (the outdoor/Aachen recipe); auto picks "
+                        "by whether the scene provides GT coordinates")
+    p.add_argument("--init-depth", type=float, default=5.0,
+                   help="reproj mode: constant depth (m) of the heuristic "
+                        "back-projected init targets")
+    p.add_argument("--init-iters", type=int, default=None,
+                   help="reproj mode: iterations of L1-to-heuristic-target "
+                        "bootstrap before switching to reprojection error "
+                        "(default: iterations // 4; 0 disables the bootstrap)")
+    p.add_argument("--reproj-clamp", type=float, default=100.0,
+                   help="reproj mode: per-cell pixel-error clamp")
     args = p.parse_args(argv)
     maybe_force_cpu(args)
 
     ds = open_scene(args.root, args.scene, "training", **scene_kwargs(args))
     center = scene_center_of(ds)
     net = make_expert(args.size, center)
+    has_coords = ds[0].coords_gt is not None
+    mode = args.loss if args.loss != "auto" else ("coords" if has_coords else "reproj")
+    if mode == "coords" and not has_coords:
+        p.error(f"scene {args.scene} has no GT coordinates; use --loss reproj")
+    if mode == "reproj" and args.augment:
+        p.error("--augment requires GT coordinates (coords mode)")
 
     probe = batch_frames(ds, np.array([0]))
     params = net.init(jax.random.key(args.seed), probe["images"])
@@ -53,6 +74,19 @@ def main(argv=None) -> int:
     opt = optax.adam(optax.cosine_decay_schedule(args.learningrate, args.iterations, 0.05))
     opt_state = opt.init(params)
     step = make_expert_train_step(net, opt)
+    if mode == "reproj":
+        from esac_tpu.data.synthetic import output_pixel_grid
+        from esac_tpu.geometry import backproject_at_depth, rodrigues
+        from esac_tpu.train import make_expert_reproj_train_step
+
+        H, W = ds[0].image.shape[:2]
+        pixels = output_pixel_grid(H, W, 8)
+        cvec = jnp.asarray([W / 2.0, H / 2.0])
+        reproj_step = make_expert_reproj_train_step(
+            net, opt, pixels, cvec, clamp_px=args.reproj_clamp
+        )
+        init_iters = (args.init_iters if args.init_iters is not None
+                      else args.iterations // 4)
 
     out = args.output or f"ckpt_expert_{args.scene}"
     start_it = 0
@@ -64,8 +98,21 @@ def main(argv=None) -> int:
     # gather instead of a host->device copy (the remote-TPU tunnel makes
     # per-iteration transfers the bottleneck otherwise).
     all_b = batch_frames(ds, np.arange(len(ds)))
-    images_d, coords_d = all_b["images"], all_b["coords_gt"]
-    masks_d = (jnp.abs(coords_d).sum(-1) > 1e-9).astype(jnp.float32)
+    images_d = all_b["images"]
+    if mode == "coords":
+        coords_d = all_b["coords_gt"]
+        masks_d = (jnp.abs(coords_d).sum(-1) > 1e-9).astype(jnp.float32)
+    else:
+        rvecs_d, tvecs_d = all_b["rvecs"], all_b["tvecs"]
+        focals_d = all_b["focals"]  # (B,): outdoor scenes mix cameras
+        # Heuristic constant-depth targets for the bootstrap phase,
+        # computed once for the whole scene (SURVEY.md §0 outdoor init).
+        heur_d = jax.jit(jax.vmap(
+            lambda rv, tv, fo: backproject_at_depth(
+                rodrigues(rv), tv, pixels, fo, cvec, args.init_depth
+            )
+        ))(rvecs_d, tvecs_d, focals_d).reshape(len(ds), H // 8, W // 8, 3)
+        ones_mask = jnp.ones((args.batch,) + heur_d.shape[1:3])
 
     if args.augment:
         from esac_tpu.data.augment import augment_frame
@@ -94,22 +141,36 @@ def main(argv=None) -> int:
         if it < start_it:  # fast-forward the data stream on resume
             continue
         idx = jnp.asarray(idx)
-        if args.augment:
+        if mode == "reproj":
+            if it < init_iters:  # L1 bootstrap to heuristic-depth targets
+                params, opt_state, loss = step(
+                    params, opt_state, images_d[idx], heur_d[idx], ones_mask
+                )
+            else:
+                params, opt_state, loss = reproj_step(
+                    params, opt_state, images_d[idx],
+                    rvecs_d[idx], tvecs_d[idx], focals_d[idx],
+                )
+        elif args.augment:
             sub = jax.random.fold_in(aug_key, it)  # per-iteration: resume-exact
             images_b, coords_b = augment_batch(sub, idx)
             masks_b = (jnp.abs(coords_b).sum(-1) > 1e-9).astype(jnp.float32)
+            params, opt_state, loss = step(
+                params, opt_state, images_b, coords_b, masks_b
+            )
         else:
-            images_b, coords_b, masks_b = images_d[idx], coords_d[idx], masks_d[idx]
-        params, opt_state, loss = step(
-            params, opt_state, images_b, coords_b, masks_b
-        )
+            params, opt_state, loss = step(
+                params, opt_state, images_d[idx], coords_d[idx], masks_d[idx]
+            )
         if it % max(1, args.iterations // 20) == 0:
-            print(f"iter {it:7d}  coord L1 {float(loss):.4f}  "
+            label = "coord L1" if mode == "coords" else (
+                "init L1" if it < init_iters else "reproj px")
+            print(f"iter {it:7d}  {label} {float(loss):.4f}  "
                   f"({(time.time() - t0):.0f}s)", flush=True)
         last_it = it + 1
         if (args.checkpoint_every and last_it % args.checkpoint_every == 0
                 and last_it < args.iterations):
-            save_train_state(out, params, _ck_config(args, center, loss),
+            save_train_state(out, params, _ck_config(args, center, loss, mode),
                              opt_state, iteration=last_it)
             print(f"checkpoint {out} @ iter {last_it}", flush=True)
         if args.stop_after and last_it - start_it >= args.stop_after:
@@ -120,18 +181,20 @@ def main(argv=None) -> int:
         # NaN — re-saving would clobber the checkpoint's real final_loss.
         print(f"{out} already at iteration {last_it}; nothing to do")
         return 0
-    save_train_state(out, params, _ck_config(args, center, loss),
+    save_train_state(out, params, _ck_config(args, center, loss, mode),
                      opt_state, iteration=last_it)
-    print(f"saved {out}  final coord L1 {float(loss):.4f}")
+    unit = "coord L1" if mode == "coords" else "reproj px"
+    print(f"saved {out}  final {unit} {float(loss):.4f}")
     return 0
 
 
-def _ck_config(args, center, loss) -> dict:
+def _ck_config(args, center, loss, mode="coords") -> dict:
     return {
         "kind": "expert",
         "size": args.size,
         "scene": args.scene,
         "scene_center": [float(x) for x in center],
+        "loss_mode": mode,
         "final_loss": float(loss),
     }
 
