@@ -230,22 +230,26 @@ def measure_on_device(
     failure.  The child is never killed: on deadline it is left orphaned."""
     # Another sanctioned TPU job (tools/chip_recovery.sh's queue) may own the
     # chip; wait for its .tpu_busy sentinel rather than becoming a second
-    # concurrent client.  A stale sentinel (owner dead) is ignored.
+    # concurrent client.  Patience is bounded by the caller's deadline_s; a
+    # stale sentinel (owner dead, or older than 8h — PID reuse guard) is
+    # removed and ignored.
     busy = _REPO / ".tpu_busy"
-    wait_deadline = time.time() + 3600
+    wait_deadline = time.time() + deadline_s
     while busy.exists():
         try:
             owner = int(busy.read_text().strip())
+            age_s = time.time() - busy.stat().st_mtime
         except Exception:
-            owner = None
-        if owner is not None and not _pid_running(owner):
-            break  # stale sentinel: owner died without cleanup
+            owner, age_s = None, 0.0
+        if owner is None or not _pid_running(owner) or age_s > 8 * 3600:
+            busy.unlink(missing_ok=True)  # stale: owner gone or pid recycled
+            break
         if time.time() >= wait_deadline:
             # Owner still alive and working: becoming a second concurrent
             # TPU client is the one thing this sentinel exists to prevent —
             # fall back to CPU instead.
             return None
-        time.sleep(15.0)
+        time.sleep(min(15.0, max(1.0, deadline_s / 10)))
     alive, reason = relay_alive()
     if not alive:
         return None

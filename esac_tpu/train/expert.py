@@ -63,6 +63,15 @@ def reprojection_loss(
     pred: (B, h, w, 3) or (B, N, 3); rvecs/tvecs: (B, 3); pixels: (N, 2);
     fs: scalar or (B,) focal lengths — outdoor datasets carry per-frame
     intrinsics, so the focal is batched alongside the poses.
+
+    The clamp is LOGARITHMIC, not a hard min: ``clamp * log1p(err/clamp)``
+    tracks the raw error below ``clamp_px`` (slope 1 at 0) but damps large
+    errors with a 1/(1 + err/clamp) slope that never reaches zero — a hard
+    ``min`` would hand every >clamp cell (including behind-camera cells,
+    which carry err+1000 by design) exactly zero gradient and stall
+    training whenever most cells start far from their pixels (e.g.
+    ``--init-iters 0``).  Grad-safety per CLAUDE.md: degenerate inputs keep
+    a penalty that still drives gradients.
     """
     B = pred.shape[0]
     coords = pred.reshape(B, -1, 3)
@@ -71,7 +80,7 @@ def reprojection_loss(
     errs = jax.vmap(
         lambda R, t, co, f: reprojection_errors(R, t, co, pixels, f, c)
     )(Rs, tvecs, coords, fs)
-    return jnp.mean(jnp.minimum(errs, clamp_px))
+    return jnp.mean(clamp_px * jnp.log1p(errs / clamp_px))
 
 
 def make_expert_reproj_train_step(

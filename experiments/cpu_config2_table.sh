@@ -1,0 +1,37 @@
+#!/bin/sh
+# Config-#2 (gating + M experts) accuracy table at CPU-feasible scale:
+# 4 synthetic scenes, test-size nets, full 3-stage pipeline through the real
+# entry points, evaluated on the novel-view test split with BOTH backends on
+# matched checkpoints.  Insurance evidence for the jax-vs-cpp
+# matched-accuracy table while the TPU relay is down; the ref-scale
+# pipeline (experiments/ref_scale_pipeline.sh) supersedes it when the chip
+# returns.  Runs entirely on CPU (--cpu everywhere): safe to run any time.
+set -e
+cd "$(dirname "$0")/.."
+
+SCENES="synth0 synth1 synth2 synth3"
+E1="ckpt_cpu2_expert_synth0 ckpt_cpu2_expert_synth1 ckpt_cpu2_expert_synth2 ckpt_cpu2_expert_synth3"
+
+echo "=== stage 1 ($(date)) ==="
+for s in $SCENES; do
+  python train_expert.py "$s" --cpu --size test --batch 8 \
+    --iterations 2500 --learningrate 1e-3 --output "ckpt_cpu2_expert_$s"
+done
+
+echo "=== stage 2 ($(date)) ==="
+python train_gating.py $SCENES --cpu --size test --batch 8 \
+  --iterations 600 --learningrate 1e-3 --output ckpt_cpu2_gating
+
+echo "=== stage 3 ($(date)) ==="
+python train_esac.py $SCENES --cpu --size test --batch 2 --hypotheses 32 \
+  --iterations 150 --learningrate 1e-5 \
+  --experts $E1 --gating ckpt_cpu2_gating --output ckpt_cpu2_esac
+
+E3="ckpt_cpu2_esac_expert0 ckpt_cpu2_esac_expert1 ckpt_cpu2_esac_expert2 ckpt_cpu2_esac_expert3"
+echo "=== eval jax ($(date)) ==="
+python test_esac.py $SCENES --cpu --size test --limit 8 --hypotheses 256 \
+  --experts $E3 --gating ckpt_cpu2_esac_gating
+echo "=== eval cpp ($(date)) ==="
+python test_esac.py $SCENES --cpu --size test --limit 8 --hypotheses 256 \
+  --experts $E3 --gating ckpt_cpu2_esac_gating --backend cpp
+echo "=== done ($(date)) ==="

@@ -43,7 +43,8 @@ python train_gating.py $SCENES --size ref --frames 1024 --res $RES \
 
 echo "=== eval before stage 3, jax backend ($(date)) ==="
 python test_esac.py $SCENES --size ref --frames 64 --res $RES \
-  --experts $EXPERTS --gating ckpt_ref_gating --hypotheses 256
+  --experts $EXPERTS --gating ckpt_ref_gating --hypotheses 256 \
+  --json .ref_eval_stage2_jax.json
 
 echo "=== stage 3: end-to-end ($(date)) ==="
 python train_esac.py $SCENES --size ref --frames 512 --res $RES \
@@ -54,10 +55,12 @@ python train_esac.py $SCENES --size ref --frames 512 --res $RES \
 E3="ckpt_ref_esac_expert0 ckpt_ref_esac_expert1 ckpt_ref_esac_expert2 ckpt_ref_esac_expert3"
 echo "=== eval after stage 3, jax backend ($(date)) ==="
 python test_esac.py $SCENES --size ref --frames 64 --res $RES \
-  --experts $E3 --gating ckpt_ref_esac_gating --hypotheses 256
+  --experts $E3 --gating ckpt_ref_esac_gating --hypotheses 256 \
+  --json .ref_eval_stage3_jax.json
 
 echo "=== eval after stage 3, cpp backend ($(date)) ==="
 python test_esac.py $SCENES --size ref --frames 64 --res $RES \
-  --experts $E3 --gating ckpt_ref_esac_gating --hypotheses 256 --backend cpp
+  --experts $E3 --gating ckpt_ref_esac_gating --hypotheses 256 --backend cpp \
+  --json .ref_eval_stage3_cpp.json
 
 echo "=== pipeline done ($(date)) ==="

@@ -45,6 +45,9 @@ def main(argv=None) -> int:
                         "device round-trips, not O(frames) — the per-dispatch "
                         "relay latency of this environment makes per-frame "
                         "dispatch the dominant cost otherwise")
+    p.add_argument("--json", default=None, metavar="PATH",
+                   help="also write the metrics as a JSON file (machine-"
+                        "readable artifact for accuracy tables)")
     args = p.parse_args(argv)
     maybe_force_cpu(args)
 
@@ -168,6 +171,22 @@ def main(argv=None) -> int:
     print(f"expert accuracy:  {100.0 * expert_ok / n_total:.1f}%")
     print(f"median time:      {1e3 * np.median(tm):.1f} ms/frame "
           f"({args.hypotheses * M} hyps, backend={args.backend})")
+    if args.json:
+        import json
+
+        with open(args.json, "w") as fh:
+            json.dump({
+                "scenes": args.scenes,
+                "backend": args.backend,
+                "frames": n_total,
+                "median_rot_deg": round(float(np.median(rot)), 4),
+                "median_trans_cm": round(100 * float(np.median(tr)), 3),
+                "pct_5cm5deg": round(100.0 * ok / n_total, 2),
+                "expert_accuracy_pct": round(100.0 * expert_ok / n_total, 2),
+                "median_ms_per_frame": round(1e3 * float(np.median(tm)), 2),
+                "hypotheses_total": args.hypotheses * M,
+            }, fh, indent=2)
+        print(f"wrote {args.json}")
     return 0
 
 

@@ -105,14 +105,17 @@ def main(argv=None) -> int:
     else:
         rvecs_d, tvecs_d = all_b["rvecs"], all_b["tvecs"]
         focals_d = all_b["focals"]  # (B,): outdoor scenes mix cameras
-        # Heuristic constant-depth targets for the bootstrap phase,
-        # computed once for the whole scene (SURVEY.md §0 outdoor init).
-        heur_d = jax.jit(jax.vmap(
-            lambda rv, tv, fo: backproject_at_depth(
-                rodrigues(rv), tv, pixels, fo, cvec, args.init_depth
-            )
-        ))(rvecs_d, tvecs_d, focals_d).reshape(len(ds), H // 8, W // 8, 3)
-        ones_mask = jnp.ones((args.batch,) + heur_d.shape[1:3])
+        heur_d = None
+        if init_iters > start_it:
+            # Heuristic constant-depth targets for the bootstrap phase
+            # (SURVEY.md §0 outdoor init) — len(ds)*cells*3 floats of HBM,
+            # so only while the bootstrap actually runs; freed after.
+            heur_d = jax.jit(jax.vmap(
+                lambda rv, tv, fo: backproject_at_depth(
+                    rodrigues(rv), tv, pixels, fo, cvec, args.init_depth
+                )
+            ))(rvecs_d, tvecs_d, focals_d).reshape(len(ds), H // 8, W // 8, 3)
+        ones_mask = jnp.ones((args.batch, H // 8, W // 8))
 
     if args.augment:
         from esac_tpu.data.augment import augment_frame
@@ -147,6 +150,7 @@ def main(argv=None) -> int:
                     params, opt_state, images_d[idx], heur_d[idx], ones_mask
                 )
             else:
+                heur_d = None  # bootstrap done: free the target buffer
                 params, opt_state, loss = reproj_step(
                     params, opt_state, images_d[idx],
                     rvecs_d[idx], tvecs_d[idx], focals_d[idx],
