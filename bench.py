@@ -146,6 +146,23 @@ def _pid_running(pid) -> bool:
     return pid is not None and pathlib.Path(f"/proc/{pid}").exists()
 
 
+def _proc_start_epoch(pid) -> float | None:
+    """Unix time a pid's process started (PID-reuse detector); None if
+    /proc is unreadable or the process vanished mid-read."""
+    try:
+        with open(f"/proc/{pid}/stat") as fh:
+            # Field 22 (starttime, clock ticks since boot); split after the
+            # parenthesized comm, which may itself contain spaces.
+            ticks = int(fh.read().rsplit(") ", 1)[1].split()[19])
+        with open("/proc/stat") as fh:
+            btime = next(
+                int(line.split()[1]) for line in fh if line.startswith("btime")
+            )
+        return btime + ticks / os.sysconf("SC_CLK_TCK")
+    except Exception:
+        return None
+
+
 def _read_json(path: pathlib.Path) -> dict | None:
     try:
         with open(path) as fh:
@@ -230,25 +247,34 @@ def measure_on_device(
     failure.  The child is never killed: on deadline it is left orphaned."""
     # Another sanctioned TPU job (tools/chip_recovery.sh's queue) may own the
     # chip; wait for its .tpu_busy sentinel rather than becoming a second
-    # concurrent client.  Patience is bounded by the caller's deadline_s; a
-    # stale sentinel (owner dead, or older than 8h — PID reuse guard) is
-    # removed and ignored.
+    # concurrent client.  Patience is bounded by the caller's deadline_s.
+    # Staleness is decided by owner IDENTITY, not age: the sentinel is
+    # dropped only when the recorded pid is gone, or when that pid's process
+    # started well AFTER the sentinel was written (a recycled pid is not the
+    # owner).  Anything ambiguous — unreadable file, just-created-but-empty
+    # file, unparsable /proc — waits; the failure mode of deleting a live
+    # owner's sentinel is a second concurrent TPU client, i.e. a permanent
+    # relay wedge (CLAUDE.md), while the failure mode of waiting is a CPU
+    # fallback at the deadline.
     busy = _REPO / ".tpu_busy"
     wait_deadline = time.time() + deadline_s
     while busy.exists():
         try:
             owner = int(busy.read_text().strip())
-            age_s = time.time() - busy.stat().st_mtime
+            mtime = busy.stat().st_mtime
         except Exception:
-            owner, age_s = None, 0.0
-        if owner is None or not _pid_running(owner) or age_s > 8 * 3600:
-            busy.unlink(missing_ok=True)  # stale: owner gone or pid recycled
-            break
+            owner, mtime = None, None
+        if owner is not None:
+            if not _pid_running(owner):
+                busy.unlink(missing_ok=True)  # owner gone without cleanup
+                break
+            started = _proc_start_epoch(owner)
+            if (started is not None and mtime is not None
+                    and started > mtime + 60.0):
+                busy.unlink(missing_ok=True)  # pid recycled: not the owner
+                break
         if time.time() >= wait_deadline:
-            # Owner still alive and working: becoming a second concurrent
-            # TPU client is the one thing this sentinel exists to prevent —
-            # fall back to CPU instead.
-            return None
+            return None  # live owner still working: fall back to CPU
         time.sleep(min(15.0, max(1.0, deadline_s / 10)))
     alive, reason = relay_alive()
     if not alive:

@@ -74,6 +74,28 @@ def test_cli_reproj_mode_trains(tmp_path):
     assert np.isfinite(cfg["final_loss"])
 
 
+def test_reprojection_loss_gradient_above_clamp():
+    """The clamp is logarithmic, not a hard min: cells far above clamp_px —
+    including behind-camera cells (err+1000) — must keep a nonzero gradient,
+    or a cold start (--init-iters 0) stalls with most cells >clamp."""
+    frame = make_correspondence_frame(jax.random.key(2), noise=0.0,
+                                      outlier_frac=0.0)
+    c = jnp.asarray([320.0, 240.0])
+    rv, tv = frame["rvec"][None], frame["tvec"][None]
+    fs = jnp.float32(CAMERA_F)
+    # Every prediction collapsed far behind the camera: worst-case regime.
+    pred = jnp.full_like(frame["coords"], -50.0)[None]
+    loss, g = jax.value_and_grad(
+        lambda p: reprojection_loss(p, rv, tv, frame["pixels"], fs, c,
+                                    clamp_px=100.0)
+    )(pred)
+    assert jnp.isfinite(loss) and float(loss) > 100.0  # damped, not capped
+    assert jnp.all(jnp.isfinite(g))
+    # Nonzero gradient for (essentially) every cell, not just a lucky few.
+    per_cell = jnp.abs(g).sum(-1).ravel()
+    assert float(jnp.mean(per_cell > 0)) > 0.99
+
+
 def test_reprojection_loss_per_frame_focals():
     """Outdoor batches mix cameras: reprojection_loss must honor per-frame
     focal lengths, not broadcast frame 0's."""
@@ -121,6 +143,24 @@ def test_cli_auto_mode_on_diskscene_without_depth(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
     assert "init L1" in r.stdout
     assert load_checkpoint(tmp_path / "ck")[1]["loss_mode"] == "reproj"
+
+
+def test_cli_reproj_resume_inside_bootstrap(tmp_path):
+    """Stop during the heuristic-bootstrap phase and resume: the resumed
+    process must rebuild the bootstrap targets (heur_d is allocated only
+    when init_iters > start_it) and finish both phases."""
+    cmd = [sys.executable, str(REPO / "train_expert.py"), "synth0", "--cpu",
+           "--size", "test", "--batch", "2", "--iterations", "24",
+           "--learningrate", "1e-3", "--loss", "reproj", "--init-iters", "12",
+           "--output", str(tmp_path / "ck")]
+    r1 = subprocess.run(cmd + ["--stop-after", "6"], capture_output=True,
+                        text=True, cwd=REPO, timeout=900)
+    assert r1.returncode == 0, r1.stdout + r1.stderr
+    r2 = subprocess.run(cmd + ["--resume"], capture_output=True, text=True,
+                        cwd=REPO, timeout=900)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "resumed" in r2.stdout and "init L1" in r2.stdout
+    assert "reproj px" in r2.stdout  # second phase reached after resume
 
 
 def test_cli_rejects_reproj_plus_augment():
