@@ -258,20 +258,54 @@ def measure_on_device(
     # fallback at the deadline.
     busy = _REPO / ".tpu_busy"
     wait_deadline = time.time() + deadline_s
-    while busy.exists():
+
+    def _unlink_if_unchanged(expect_text) -> bool:
+        """Drop the sentinel only if its contents still match what we judged
+        stale — a new owner may have rewritten the file between our read and
+        this unlink, and deleting a LIVE owner's sentinel makes two
+        concurrent TPU clients (permanent relay wedge, CLAUDE.md)."""
         try:
-            owner = int(busy.read_text().strip())
-            mtime = busy.stat().st_mtime
+            if busy.read_text() != expect_text:
+                return False  # rewritten since our read: re-evaluate
+        except FileNotFoundError:
+            return True  # owner cleaned up by itself
         except Exception:
-            owner, mtime = None, None
+            if expect_text is not None:
+                return False  # was readable, now isn't: re-evaluate
+        busy.unlink(missing_ok=True)
+        return True
+
+    while busy.exists():
+        mtime = owner = raw = None
+        try:
+            raw = busy.read_text()
+            mtime = busy.stat().st_mtime
+        except FileNotFoundError:
+            break
+        except Exception:
+            pass
+        if raw is not None:
+            try:
+                owner = int(raw.strip())
+            except ValueError:
+                owner = None
         if owner is not None:
             if not _pid_running(owner):
-                busy.unlink(missing_ok=True)  # owner gone without cleanup
-                break
-            started = _proc_start_epoch(owner)
-            if (started is not None and mtime is not None
-                    and started > mtime + 60.0):
-                busy.unlink(missing_ok=True)  # pid recycled: not the owner
+                # Owner gone without cleanup.
+                if _unlink_if_unchanged(raw):
+                    break
+            else:
+                started = _proc_start_epoch(owner)
+                if (started is not None and mtime is not None
+                        and started > mtime + 60.0):
+                    # Recorded pid was recycled: not the owner.
+                    if _unlink_if_unchanged(raw):
+                        break
+        elif mtime is not None and time.time() - mtime > 24 * 3600.0:
+            # Unparsable sentinel that can never identify an owner: age out
+            # after a day so a crashed writer can't disable device
+            # measurement forever.  (Ambiguous-but-young still waits.)
+            if _unlink_if_unchanged(raw):
                 break
         if time.time() >= wait_deadline:
             return None  # live owner still working: fall back to CPU
@@ -310,7 +344,11 @@ def main() -> None:
     note = None
     res = measure_on_device(kwargs)
     if res is None:
-        note = "device measurement unavailable (relay wedged or child failed); jax path measured on CPU"
+        note = (
+            "device measurement unavailable (relay wedged or child failed); "
+            "jax path measured on CPU. Hardware numbers for this round are in "
+            "the committed BENCH_TPU.json (TPU v5 lite, wedge-safe protocol)."
+        )
         import jax
 
         jax.config.update("jax_platforms", "cpu")

@@ -43,11 +43,24 @@ class RansacConfig:
     # cell, so final pose quality is unaffected.  The reference scores all
     # cells; keep 0 for strict parity.
     score_cells: int = 0
-    # Use the fused Pallas scoring kernel (ransac/pallas_scoring.py) instead
-    # of the XLA error-map path.  Inference-path only (the kernel defines no
-    # VJP); falls back to interpret mode off-TPU.  Default off until
-    # validated on hardware (the TPU was unreachable when it was written —
-    # see CLAUDE.md); interpret-mode equivalence is tested.
+    # Scoring implementation:
+    #   "errmap"     — reprojection_error_map (hmm matmul) + sigmoid-sum; the
+    #                  reference-parity formulation, materializes (H, N, 3)
+    #                  transformed points through the dot.
+    #   "fused"      — one fused XLA broadcast+reduce program, f32
+    #                  (pallas_scoring.soft_inlier_scores_fused): no
+    #                  intermediate map in HBM, plain autodiff.
+    #   "pallas"     — the hand-written Pallas VMEM kernel (custom_vjp).
+    # A bf16 variant of "fused" was tried and REJECTED: bf16 ULP on rotation
+    # entries (~4e-3) shifts every projected cell of a hypothesis by ~2 px
+    # systematically, and the correlated sigmoid shifts summed over thousands
+    # of cells measured a 10% score deviation at full resolution — enough to
+    # flip argmax winners.  Scoring precision stays f32.
+    # Default is decided by the hardware A/B (tools/pallas_ab.py); "errmap"
+    # until a measured win is recorded in .pallas_ab.json.
+    scoring_impl: str = "errmap"
+    # Back-compat alias: True forces scoring_impl="pallas" (kept so round-1
+    # call sites and the A/B harness keep working).
     use_pallas_scoring: bool = False
     # Differentiate the training expectation through the per-hypothesis
     # refined pose losses (autodiff-through-IRLS — the jax replacement for

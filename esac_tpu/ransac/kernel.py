@@ -40,12 +40,15 @@ def _score_hypotheses(key, rvecs, tvecs, coords, pixels, f, c, cfg):
     The single source of truth for hypothesis scoring — the ESAC multi-expert
     path calls this too, so scale corrections stay in one place.
 
-    The fused Pallas kernel carries a custom_vjp (analytic XLA backward
-    mirroring the kernel math), so training and inference both honor
-    cfg.use_pallas_scoring.
+    Implementation is selected by cfg.scoring_impl ("errmap" | "fused" |
+    "pallas"; see RansacConfig) — all three are differentiable, so training
+    and inference both honor it.  cfg.use_pallas_scoring=True is the
+    back-compat override forcing "pallas" (custom_vjp with an analytic XLA
+    backward mirroring the kernel math).
     """
     coords_s, pixels_s, scale = subsample_cells(key, coords, pixels, cfg.score_cells)
-    if cfg.use_pallas_scoring:
+    impl = "pallas" if cfg.use_pallas_scoring else cfg.scoring_impl
+    if impl == "pallas":
         from esac_tpu.ransac.pallas_scoring import soft_inlier_scores_pallas
 
         return soft_inlier_scores_pallas(
@@ -53,6 +56,15 @@ def _score_hypotheses(key, rvecs, tvecs, coords, pixels, f, c, cfg):
             cfg.tau, cfg.beta,
             interpret=jax.default_backend() != "tpu",
         ) * scale
+    if impl == "fused":
+        from esac_tpu.ransac.pallas_scoring import soft_inlier_scores_fused
+
+        return soft_inlier_scores_fused(
+            rodrigues(rvecs), tvecs, coords_s, pixels_s, f, c,
+            cfg.tau, cfg.beta,
+        ) * scale
+    if impl != "errmap":
+        raise ValueError(f"unknown RansacConfig.scoring_impl: {impl!r}")
     errors = reprojection_error_map(rvecs, tvecs, coords_s, pixels_s, f, c)
     return soft_inlier_score(errors, cfg.tau, cfg.beta) * scale
 

@@ -138,3 +138,99 @@ def test_no_kill_calls_anywhere_in_bench_source():
     src = (pathlib.Path(bench.__file__)).read_text()
     for banned in (".kill(", ".terminate(", "timeout="):
         assert banned not in src, f"bench.py contains {banned!r}"
+
+
+import os
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _isolated_repo(tmp_path, monkeypatch):
+    """Point bench's sentinel paths at tmp_path so these tests neither see
+    nor disturb a real .tpu_busy written by a sanctioned TPU job (the
+    chip-recovery runbook may own the chip while the suite runs)."""
+    monkeypatch.setattr(bench, "_REPO", tmp_path)
+    yield
+
+
+def test_busy_sentinel_live_owner_waits_then_cpu_fallback(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "_PROBE_FILE", tmp_path / "probe.json")
+    _write_phase("ok")
+    (tmp_path / ".tpu_busy").write_text(str(os.getpid()))  # us: alive forever
+    t0 = time.time()
+    res = bench.measure_on_device({}, deadline_s=2)
+    assert res is None  # fell back without deleting the live owner's file
+    assert (tmp_path / ".tpu_busy").exists()
+    assert time.time() - t0 >= 2
+
+
+def test_busy_sentinel_dead_owner_is_cleared(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "_PROBE_FILE", tmp_path / "probe.json")
+    monkeypatch.setattr(bench, "_RESULT_FILE", tmp_path / "result.json")
+    _write_phase("ok")
+    # A pid that cannot exist (pid_max is far below 2**22 reads here).
+    (tmp_path / ".tpu_busy").write_text("4194304")
+
+    def fake_spawn(argv, log):
+        (tmp_path / "result.json").write_text(
+            json.dumps({"rate": 1.0, "platform": "tpu", "device_kind": "fake"})
+        )
+        return _FakeChild()
+
+    monkeypatch.setattr(bench, "_spawn_orphan", fake_spawn)
+    res = bench.measure_on_device({}, deadline_s=5)
+    assert res is not None
+    assert not (tmp_path / ".tpu_busy").exists()
+
+
+def test_busy_sentinel_rewritten_by_new_owner_not_deleted(tmp_path, monkeypatch):
+    """The read-then-unlink race: if a NEW live owner rewrites .tpu_busy
+    after we judged the old contents stale, the unlink must not happen."""
+    busy = tmp_path / ".tpu_busy"
+    busy.write_text("4194304")  # dead owner
+
+    calls = {"n": 0}
+    real_read = type(busy).read_text
+
+    def racing_read(self, *a, **k):
+        out = real_read(self, *a, **k)
+        if calls["n"] == 0 and self.name == ".tpu_busy":
+            # Between the wait-loop's read and the unlink re-check, a new
+            # owner (alive: our own pid) takes the sentinel.
+            busy.write_text(str(os.getpid()))
+        calls["n"] += 1
+        return out
+
+    monkeypatch.setattr(type(busy), "read_text", racing_read)
+    monkeypatch.setattr(bench, "_PROBE_FILE", tmp_path / "probe.json")
+    _write_phase("ok")
+    res = bench.measure_on_device({}, deadline_s=2)
+    assert res is None  # waited on the new owner, then CPU fallback
+    assert busy.exists() and busy.read_text() == str(os.getpid())
+
+
+def test_busy_sentinel_unparsable_ages_out_after_a_day(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "_PROBE_FILE", tmp_path / "probe.json")
+    monkeypatch.setattr(bench, "_RESULT_FILE", tmp_path / "result.json")
+    _write_phase("ok")
+    busy = tmp_path / ".tpu_busy"
+    busy.write_text("not a pid")
+    day_ago = time.time() - 25 * 3600
+    os.utime(busy, (day_ago, day_ago))
+
+    def fake_spawn(argv, log):
+        (tmp_path / "result.json").write_text(
+            json.dumps({"rate": 1.0, "platform": "tpu", "device_kind": "fake"})
+        )
+        return _FakeChild()
+
+    monkeypatch.setattr(bench, "_spawn_orphan", fake_spawn)
+    res = bench.measure_on_device({}, deadline_s=5)
+    assert res is not None and not busy.exists()
+
+    # Young unparsable sentinel still waits (ambiguity is never deleted).
+    busy.write_text("not a pid")
+    t0 = time.time()
+    assert bench.measure_on_device({}, deadline_s=2) is None
+    assert busy.exists() and time.time() - t0 >= 2
