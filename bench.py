@@ -252,10 +252,13 @@ def measure_on_device(
     # dropped only when the recorded pid is gone, or when that pid's process
     # started well AFTER the sentinel was written (a recycled pid is not the
     # owner).  Anything ambiguous — unreadable file, just-created-but-empty
-    # file, unparsable /proc — waits; the failure mode of deleting a live
-    # owner's sentinel is a second concurrent TPU client, i.e. a permanent
-    # relay wedge (CLAUDE.md), while the failure mode of waiting is a CPU
-    # fallback at the deadline.
+    # file, unparsable /proc — waits, with ONE escape hatch: a sentinel whose
+    # contents can never identify an owner (unparsable) ages out after 24h so
+    # a crashed writer can't disable device measurement forever.  Deleting a
+    # live owner's sentinel means a second concurrent TPU client, i.e. a
+    # permanent relay wedge (CLAUDE.md); waiting only costs a CPU fallback at
+    # the deadline — so every unlink re-checks contents right before it fires
+    # (_unlink_if_unchanged).
     busy = _REPO / ".tpu_busy"
     wait_deadline = time.time() + deadline_s
 
@@ -270,8 +273,7 @@ def measure_on_device(
         except FileNotFoundError:
             return True  # owner cleaned up by itself
         except Exception:
-            if expect_text is not None:
-                return False  # was readable, now isn't: re-evaluate
+            return False  # was readable, now isn't: re-evaluate
         busy.unlink(missing_ok=True)
         return True
 

@@ -68,6 +68,19 @@ def common_parser(desc: str) -> argparse.ArgumentParser:
     return p
 
 
+def add_scoring_impl_arg(p: argparse.ArgumentParser) -> None:
+    """--scoring-impl for the scripts that run the hypothesis loop
+    (train_esac.py / test_esac.py); stage-1/2 trainers build no RansacConfig
+    so the flag would be dead weight in common_parser."""
+    p.add_argument("--scoring-impl", choices=("errmap", "fused", "pallas"),
+                   default="errmap",
+                   help="hypothesis-scoring implementation (jax backend): "
+                        "errmap = reference-parity error map, fused = one "
+                        "fused XLA broadcast+reduce program, pallas = the "
+                        "hand-written TPU VMEM kernel; all differentiable "
+                        "(see RansacConfig.scoring_impl)")
+
+
 def scene_kwargs(args) -> dict:
     """open_scene kwargs from the synthetic-scale flags (--frames/--res)."""
     kw = {}

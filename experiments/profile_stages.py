@@ -5,8 +5,9 @@ Pallas kernel, or does scoring dominate?  Each stage is isolated into its
 own jitted function at BASELINE.md config #1 shapes (batch 16 x 256 hyps,
 4800 cells) and fenced with block_until_ready.  Writes one JSON line:
 
-  {"sample_solve_ms": ..., "score_ms": ..., "refine_ms": ...,
-   "full_ms": ..., "device_kind": ...}
+  {"sample_solve_ms": ..., "score_ms_errmap": ..., "score_ms_fused": ...,
+   "score_ms_pallas": ..., "refine_ms": ..., "full_ms": ...,
+   "score_ms": <the default impl's time>, "device_kind": ...}
 
 CPU-safe (runs anywhere); meaningful numbers need the real chip.  Launch
 detached on TPU (CLAUDE.md wedge hazards).
@@ -57,9 +58,14 @@ def main() -> None:
     ))
     rvs, tvs = gen(rkeys, coords, pixels)
 
-    score = jax.jit(jax.vmap(
-        lambda k, rv, tv, co, px: _score_hypotheses(k, rv, tv, co, px, f32, c, cfg)
-    ))
+    score_fns = {}
+    for impl in ("errmap", "fused", "pallas"):
+        icfg = RansacConfig(n_hyps=N_HYPS, scoring_impl=impl)
+        score_fns[impl] = jax.jit(jax.vmap(
+            lambda k, rv, tv, co, px, icfg=icfg: _score_hypotheses(
+                k, rv, tv, co, px, f32, c, icfg)
+        ))
+    score = score_fns[cfg.scoring_impl]
     scores = score(rkeys, rvs, tvs, coords, pixels)
 
     refine = jax.jit(jax.vmap(
@@ -76,13 +82,17 @@ def main() -> None:
 
     res = {
         "sample_solve_ms": round(_ms(gen, (rkeys, coords, pixels)), 3),
-        "score_ms": round(_ms(score, (rkeys, rvs, tvs, coords, pixels)), 3),
+        **{f"score_ms_{impl}": round(
+            _ms(fn, (rkeys, rvs, tvs, coords, pixels)), 3)
+           for impl, fn in score_fns.items()},
         "refine_ms": round(_ms(refine, (rb, tb, coords, pixels)), 3),
         "full_ms": round(_ms(full, (rkeys, coords, pixels)), 3),
         "batch": BATCH, "n_hyps": N_HYPS,
         "device_kind": jax.devices()[0].device_kind,
         "platform": jax.devices()[0].platform,
     }
+    # Legacy key: the scoring time of the configured default impl.
+    res["score_ms"] = res[f"score_ms_{cfg.scoring_impl}"]
     line = json.dumps(res)
     (REPO / ".profile_stages.json").write_text(line)
     print(line, flush=True)

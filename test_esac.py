@@ -22,7 +22,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from esac_tpu.cli import (
-    common_parser, make_expert, make_gating, maybe_force_cpu, open_scene,
+    add_scoring_impl_arg, common_parser, make_expert, make_gating,
+    maybe_force_cpu, open_scene,
     scene_kwargs,
 )
 from esac_tpu.data.synthetic import output_pixel_grid
@@ -33,6 +34,7 @@ from esac_tpu.utils.checkpoint import load_checkpoint
 
 def main(argv=None) -> int:
     p = common_parser(__doc__)
+    add_scoring_impl_arg(p)
     p.add_argument("scenes", nargs="+")
     p.add_argument("--experts", nargs="+", required=True)
     p.add_argument("--gating", required=True)
@@ -76,7 +78,7 @@ def main(argv=None) -> int:
     H, W = f0.image.shape[:2]
     pixels = output_pixel_grid(H, W, 8)
     cx = jnp.asarray([W / 2.0, H / 2.0])
-    cfg = RansacConfig(n_hyps=args.hypotheses)
+    cfg = RansacConfig(n_hyps=args.hypotheses, scoring_impl=args.scoring_impl)
 
     @jax.jit
     def predict_coords(images):

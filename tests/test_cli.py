@@ -57,9 +57,12 @@ def test_train_esac_end_to_end(pipeline_ckpts):
 @pytest.mark.parametrize("backend", ["jax", "cpp"])
 def test_test_esac_reports_metrics(pipeline_ckpts, backend):
     d = pipeline_ckpts
+    # --scoring-impl fused exercises the CLI wiring of the scoring impl on
+    # the jax backend (the cpp backend scores in C++ and ignores it).
     out = run(
         "test_esac.py", "synth0", "synth1", "--cpu", "--size", "test",
         "--backend", backend, "--hypotheses", "16", "--limit", "2",
+        "--scoring-impl", "fused",
         "--experts", str(d / "e0"), str(d / "e1"), "--gating", str(d / "g"),
     )
     assert "median rot err" in out

@@ -1,11 +1,17 @@
 """A/B the fused Pallas scoring kernel vs the XLA path on the real chip.
 
-VERDICT round-1 item #5: measure use_pallas_scoring=True vs False on
-hardware and record the result; the default flips only on a measured win.
-Writes ONE JSON line to stdout and to .pallas_ab.json:
+VERDICT round-1 item #5, extended to every RansacConfig.scoring_impl:
+measure "errmap" / "fused" / "pallas" on hardware and record the result;
+the default flips only on a measured win.  Writes ONE JSON line to stdout
+and to .pallas_ab.json:
 
-  {"xla_hyps_per_sec": ..., "pallas_hyps_per_sec": ..., "speedup": ...,
-   "max_abs_score_diff": ..., "device_kind": ...}
+  {"<impl>_hyps_per_sec": ...,            # full dsac_infer pipeline, per impl
+   "scoring_only_<impl>": ...,            # scoring-stage microbench, per impl
+   "max_abs_score_diff_<impl>": ...,      # vs errmap, for impl != errmap
+   "default_candidate": "<impl>",         # fastest impl with score agreement
+   "device_kind": ..., "platform": ...,
+   # back-compat keys: xla_hyps_per_sec (== errmap), speedup
+   # (pallas/errmap), max_abs_score_diff (pallas), scoring_only_xla}
 
 Runs the full dsac_infer pipeline both ways (the kernel sits in the scoring
 slot) plus a scoring-only microbench, at BASELINE.md config #1 shapes.

@@ -23,7 +23,8 @@ import numpy as np
 import optax
 
 from esac_tpu.cli import (
-    batch_frames, common_parser, make_expert, make_gating, maybe_force_cpu,
+    add_scoring_impl_arg, batch_frames, common_parser, make_expert,
+    make_gating, maybe_force_cpu,
     open_scene,
     scene_kwargs,
 )
@@ -37,6 +38,7 @@ from esac_tpu.utils.checkpoint import (
 
 def main(argv=None) -> int:
     p = common_parser(__doc__)
+    add_scoring_impl_arg(p)
     p.add_argument("scenes", nargs="+")
     p.add_argument("--experts", nargs="+", required=True,
                    help="stage-1 expert checkpoint dirs, one per scene")
@@ -85,7 +87,8 @@ def main(argv=None) -> int:
     stride = 8
     pixels = output_pixel_grid(H, W, stride)
     cfg = RansacConfig(n_hyps=args.hypotheses, train_refine_iters=1,
-                       alpha=args.alpha, loss_clamp=args.loss_clamp)
+                       alpha=args.alpha, loss_clamp=args.loss_clamp,
+                       scoring_impl=args.scoring_impl)
     cx = jnp.asarray([W / 2.0, H / 2.0])
 
     cpp_losses = None
