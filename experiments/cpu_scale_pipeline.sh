@@ -1,0 +1,60 @@
+#!/bin/sh
+# CPU-scale mirror of ref_scale_pipeline.sh: the same 3-stage pipeline and
+# dual-backend eval through the REAL entry points, at shapes one CPU core can
+# train in ~1-2h.  Exists as the hedge for the jax-vs-cpp matched-accuracy
+# table (VERDICT r1 "next round" #2) when the TPU relay is down; the TPU
+# pipeline supersedes these numbers whenever it completes.
+#
+# Everything runs with --cpu (never touches the relay), so it can run
+# concurrently with TPU jobs.  Resumable like the ref pipeline.
+set -e
+cd "$(dirname "$0")/.."
+
+SCENES="synth0 synth1 synth2"
+EXPERTS="ckpt_cpu_expert_synth0 ckpt_cpu_expert_synth1 ckpt_cpu_expert_synth2"
+
+# Same contract as ref_scale_pipeline.sh: stage-1/2 trainers keep opt_state
+# inside the output dir; stage 3 uses the separate <output>_state dir (pass
+# that name explicitly).
+resume_flag() {
+  if [ -d "$1/opt_state" ] || [ -d "$1.old/opt_state" ]; then echo "--resume"; fi
+  return 0
+}
+
+echo "=== cpu stage 1: experts ($(date)) ==="
+for s in $SCENES; do
+  ck="ckpt_cpu_expert_$s"
+  echo "--- expert $s ---"
+  python train_expert.py "$s" --cpu --size test --frames 768 \
+    --iterations 4000 --learningrate 1e-3 --batch 8 \
+    --checkpoint-every 1000 $(resume_flag "$ck") --output "$ck"
+done
+
+echo "=== cpu stage 2: gating ($(date)) ==="
+python train_gating.py $SCENES --cpu --size test --frames 256 \
+  --iterations 1200 --learningrate 1e-3 --batch 8 \
+  --checkpoint-every 400 $(resume_flag ckpt_cpu_gating) --output ckpt_cpu_gating
+
+echo "=== cpu eval stage 2, jax ($(date)) ==="
+python test_esac.py $SCENES --cpu --size test --frames 16 \
+  --experts $EXPERTS --gating ckpt_cpu_gating --hypotheses 64 \
+  --json .cpu_eval_stage2_jax.json
+
+echo "=== cpu stage 3: end-to-end ($(date)) ==="
+python train_esac.py $SCENES --cpu --size test --frames 128 \
+  --iterations 150 --learningrate 1e-5 --batch 2 --hypotheses 16 \
+  --checkpoint-every 50 $(resume_flag ckpt_cpu_esac_state) \
+  --experts $EXPERTS --gating ckpt_cpu_gating --output ckpt_cpu_esac
+
+E3="ckpt_cpu_esac_expert0 ckpt_cpu_esac_expert1 ckpt_cpu_esac_expert2"
+echo "=== cpu eval stage 3, jax ($(date)) ==="
+python test_esac.py $SCENES --cpu --size test --frames 16 \
+  --experts $E3 --gating ckpt_cpu_esac_gating --hypotheses 64 \
+  --json .cpu_eval_stage3_jax.json
+
+echo "=== cpu eval stage 3, cpp ($(date)) ==="
+python test_esac.py $SCENES --cpu --size test --frames 16 \
+  --experts $E3 --gating ckpt_cpu_esac_gating --hypotheses 64 --backend cpp \
+  --json .cpu_eval_stage3_cpp.json
+
+echo "=== cpu pipeline done ($(date)) ==="

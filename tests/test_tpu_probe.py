@@ -1,0 +1,96 @@
+"""Unit tests for the probe's fast-failure self-retry (tools/tpu_probe.py).
+
+The UNAVAILABLE-retry loop re-execs the probe in place (same pid) so the
+chip-recovery supervisor's liveness accounting survives; these tests pin the
+retry/give-up decision logic without touching any backend.
+"""
+
+import importlib.util
+import json
+import pathlib
+import sys
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "tpu_probe", pathlib.Path(__file__).parent.parent / "tools" / "tpu_probe.py"
+)
+tpu_probe = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(tpu_probe)
+# Captured before the autouse fixture zeroes the sleep for the retry tests.
+_REAL_RETRY_SLEEP_S = tpu_probe.RETRY_SLEEP_S
+
+
+@pytest.fixture(autouse=True)
+def _isolated(tmp_path, monkeypatch):
+    monkeypatch.setattr(tpu_probe, "RESULT", str(tmp_path / "probe.json"))
+    monkeypatch.setattr(tpu_probe, "RETRY_SLEEP_S", 0.0)
+    yield
+
+
+def test_retry_reexecs_same_process_with_attempt_bump(monkeypatch):
+    calls = {}
+
+    def fake_execve(exe, argv, env):
+        calls["exe"], calls["argv"], calls["env"] = exe, argv, env
+        raise SystemExit(0)  # execve never returns; emulate by exiting
+
+    monkeypatch.setattr(tpu_probe.os, "execve", fake_execve)
+    monkeypatch.setenv("TPU_PROBE_ATTEMPT", "3")
+    with pytest.raises(SystemExit):
+        tpu_probe._retry_or_give_up(RuntimeError("UNAVAILABLE: setup error"))
+    assert calls["exe"] == sys.executable
+    assert calls["argv"][1].endswith("tpu_probe.py")
+    assert calls["env"]["TPU_PROBE_ATTEMPT"] == "4"
+    phase = json.load(open(tpu_probe.RESULT))
+    assert phase["phase"] == "retry_unavailable" and phase["attempt"] == 3
+
+
+def test_gives_up_after_max_attempts(monkeypatch):
+    # Stubbed even though the give-up path must not reach it: a regression
+    # in the budget check would otherwise REPLACE the pytest process with a
+    # real TPU-touching probe (os.execve never returns).
+    def exploded(*a):  # pragma: no cover - the test fails if this runs
+        raise AssertionError("execve reached on the give-up path")
+
+    monkeypatch.setattr(tpu_probe.os, "execve", exploded)
+    monkeypatch.setenv("TPU_PROBE_ATTEMPT", str(tpu_probe.MAX_ATTEMPTS))
+    exc = RuntimeError("UNAVAILABLE")
+    with pytest.raises(RuntimeError):
+        tpu_probe._retry_or_give_up(exc)
+    # The phase file records the final attempt (supervisor sees a dead
+    # probe + this breadcrumb).
+    phase = json.load(open(tpu_probe.RESULT))
+    assert phase["attempt"] == tpu_probe.MAX_ATTEMPTS
+
+
+def test_gives_up_when_wall_clock_budget_spent(monkeypatch):
+    """Even with attempts left, a lineage older than MAX_RETRY_WALL_S must
+    die rather than overlap chip_recovery.sh's replacement probe."""
+    import time
+
+    def exploded(*a):  # pragma: no cover
+        raise AssertionError("execve reached past the wall-clock budget")
+
+    monkeypatch.setattr(tpu_probe.os, "execve", exploded)
+    monkeypatch.setenv("TPU_PROBE_ATTEMPT", "2")  # far from MAX_ATTEMPTS
+    monkeypatch.setenv(
+        "TPU_PROBE_T0", str(time.time() - tpu_probe.MAX_RETRY_WALL_S)
+    )
+    with pytest.raises(RuntimeError):
+        tpu_probe._retry_or_give_up(RuntimeError("UNAVAILABLE"))
+    phase = json.load(open(tpu_probe.RESULT))
+    assert phase["elapsed_s"] >= tpu_probe.MAX_RETRY_WALL_S - 1
+
+
+def test_retry_budget_fits_supervisor_abandonment_window():
+    """The retry lineage's wall-clock ceiling must end before
+    chip_recovery.sh's 30-min hung-probe abandonment so a fast-cycling probe
+    is never overlapped by a replacement (one watched TPU client at a time).
+    The enforced guard is MAX_RETRY_WALL_S (attempt counting alone can't
+    bound wall time under CPU contention); keep slack for the attempt in
+    flight when the budget check fires."""
+    assert tpu_probe.MAX_RETRY_WALL_S + 2 * _REAL_RETRY_SLEEP_S <= 1800
+    # Attempt cap stays a secondary bound under the same window at the
+    # nominal ~15s init cost per attempt.
+    assert tpu_probe.MAX_ATTEMPTS * (_REAL_RETRY_SLEEP_S + 15.0) <= 1800

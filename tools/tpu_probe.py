@@ -35,14 +35,65 @@ def report(phase: str, **extra) -> None:
     os.replace(tmp, RESULT)
 
 
+# Fast-failure retry budget: the relay has been observed to answer a client
+# with an immediate "UNAVAILABLE: TPU backend setup/compile error" for a
+# while and then serve a later client normally.  A probe that dies on the
+# first such error throttles recovery to its supervisor's relaunch cadence
+# (chip_recovery.sh sleeps 300s between dead probes); instead the probe
+# re-execs ITSELF (os.execv — same pid, fresh interpreter, so the
+# supervisor's kill -0 liveness accounting and the one-watched-probe
+# invariant are untouched) after a short sleep.  A HANGING attempt never
+# reaches the execv and is handled by the supervisor's 30-min abandonment,
+# same as before.  Total fast-retry budget stays under that 30-min window.
+MAX_ATTEMPTS = 18
+RETRY_SLEEP_S = 60.0
+# Hard wall-clock ceiling on the whole retry lineage, measured from the
+# FIRST attempt's start (carried across execvs in TPU_PROBE_T0).  Must end
+# before chip_recovery.sh's 30-min hung-probe abandonment: a still-retrying
+# probe is NOT inert (it re-inits every cycle), so letting it overlap a
+# replacement probe would mean two active TPU clients plus report() fights
+# over the shared phase file.  Attempt counting alone can't guarantee this —
+# under CPU contention each re-exec's jax import can take minutes.
+MAX_RETRY_WALL_S = 1500.0
+
+
+def _attempt() -> int:
+    return int(os.environ.get("TPU_PROBE_ATTEMPT", "1"))
+
+
+def _lineage_t0() -> float:
+    return float(os.environ.get("TPU_PROBE_T0") or time.time())
+
+
+def _retry_or_give_up(exc: Exception) -> None:
+    import sys
+
+    attempt = _attempt()
+    elapsed = time.time() - _lineage_t0()
+    report("retry_unavailable", attempt=attempt, elapsed_s=round(elapsed, 1),
+           error=repr(exc)[:300])
+    if (attempt >= MAX_ATTEMPTS
+            or elapsed + RETRY_SLEEP_S >= MAX_RETRY_WALL_S):
+        raise exc
+    time.sleep(RETRY_SLEEP_S)
+    env = dict(os.environ, TPU_PROBE_ATTEMPT=str(attempt + 1),
+               TPU_PROBE_T0=str(_lineage_t0()))
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+
+
 def main() -> None:
     t0 = time.time()
-    report("started")
+    os.environ.setdefault("TPU_PROBE_T0", str(t0))  # lineage start, pre-execv
+    report("started", attempt=_attempt())
     report("importing")
     import jax  # noqa: E402
 
     report("backend_init")
-    devs = jax.devices()  # blocks forever if the relay is wedged
+    try:
+        devs = jax.devices()  # blocks forever if the relay is wedged
+    except Exception as e:  # fast backend-init failure (e.g. UNAVAILABLE)
+        _retry_or_give_up(e)
+        raise  # unreachable: _retry_or_give_up execs or raises
     kind = devs[0].device_kind if devs else "none"
     report("compute", device_kind=kind, n_devices=len(devs))
     import jax.numpy as jnp
