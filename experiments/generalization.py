@@ -90,3 +90,11 @@ print(f"frames={n_frames} aug={augment} iters={iters}: train_loss={float(loss):.
 # at 200) — stage 3 overtrains past a few hundred iterations at this scale;
 # treat it as a short fine-tune with early stopping, not a long phase.
 # Stage-1 quality remains the dominant accuracy lever.
+#
+# Round-2 CPU-scale pipeline (experiments/cpu_scale_pipeline.sh, 3 scenes,
+# 4000-iter stage 1 reaching 0.044-0.063 coord L1): pre-stage-3 baseline
+# 27.1% 5cm/5deg — and 150 stage-3 iters REGRESSED it to 10.4% (train loss
+# rising).  Together with the round-1 numbers (6.2% -> 12.5% from a weak
+# stage-1): stage 3 rescues weak stage-1 baselines and harms strong ones at
+# toy scale; gate it on eval, don't run it unconditionally.  Backend parity
+# held at both checkpoints (CPU_SCALE_EVAL.json).

@@ -224,3 +224,35 @@ def test_fused_training_grad_matches_errmap():
     gf = grad_for("fused")
     assert jnp.all(jnp.isfinite(gf))
     np.testing.assert_allclose(np.asarray(gf), np.asarray(ge), rtol=5e-3, atol=1e-5)
+
+
+def test_scoring_impl_flows_through_esac_multi_expert():
+    """The multi-expert ESAC path shares _score_hypotheses, so scoring_impl
+    must change its numbers consistently: fused and errmap pick the same
+    winning expert/pose on a well-separated two-expert problem."""
+    from esac_tpu.ransac import esac_infer
+
+    frames = [
+        make_correspondence_frame(jax.random.key(20 + i), noise=0.01,
+                                  outlier_frac=0.2, **FRAME_KW)
+        for i in range(2)
+    ]
+    # Expert 0 gets frame-0's true coords, expert 1 garbage (and vice versa
+    # is not needed): gating mildly prefers expert 0.
+    coords_all = jnp.stack([
+        frames[0]["coords"],
+        frames[1]["coords"] + 5.0,  # wrong scene: large reprojection errors
+    ])
+    logits = jnp.asarray([1.0, 0.0])
+    outs = {}
+    for impl in ("errmap", "fused"):
+        cfg = RansacConfig(n_hyps=32, refine_iters=4, scoring_impl=impl)
+        outs[impl] = esac_infer(
+            jax.random.key(21), logits, coords_all, frames[0]["pixels"],
+            F, C, cfg,
+        )
+    assert int(outs["errmap"]["expert"]) == int(outs["fused"]["expert"]) == 0
+    np.testing.assert_allclose(
+        np.asarray(outs["fused"]["rvec"]), np.asarray(outs["errmap"]["rvec"]),
+        rtol=1e-3, atol=1e-4,
+    )

@@ -53,8 +53,12 @@ RETRY_SLEEP_S = 60.0
 # probe is NOT inert (it re-inits every cycle), so letting it overlap a
 # replacement probe would mean two active TPU clients plus report() fights
 # over the shared phase file.  Attempt counting alone can't guarantee this —
-# under CPU contention each re-exec's jax import can take minutes.
-MAX_RETRY_WALL_S = 1500.0
+# under CPU contention each re-exec's jax import can take minutes.  The
+# budget check gates only when the LAST attempt may start, so the ceiling
+# leaves ~10 min of slack inside the 30-min window for that attempt to
+# finish (or hang into the abandonment, at which point it has stopped
+# retrying and is inert like any other hung probe).
+MAX_RETRY_WALL_S = 1140.0
 
 
 def _attempt() -> int:
