@@ -41,8 +41,9 @@ python test_esac.py $SCENES --cpu --size test --frames 16 \
   --json .cpu_eval_stage2_jax.json
 
 echo "=== cpu stage 3: end-to-end ($(date)) ==="
+# lr 1e-6: 1e-5 regresses strong stage-1 baselines (CPU_SCALE_EVAL.json).
 python train_esac.py $SCENES --cpu --size test --frames 128 \
-  --iterations 150 --learningrate 1e-5 --batch 2 --hypotheses 16 \
+  --iterations 150 --learningrate 1e-6 --batch 2 --hypotheses 16 \
   --checkpoint-every 50 $(resume_flag ckpt_cpu_esac_state) \
   --experts $EXPERTS --gating ckpt_cpu_gating --output ckpt_cpu_esac
 

@@ -98,3 +98,10 @@ print(f"frames={n_frames} aug={augment} iters={iters}: train_loss={float(loss):.
 # stage-1): stage 3 rescues weak stage-1 baselines and harms strong ones at
 # toy scale; gate it on eval, don't run it unconditionally.  Backend parity
 # held at both checkpoints (CPU_SCALE_EVAL.json).
+#
+# Stage-3 lr sweep from the STRONG 27.1% stage-1 baseline (cpu_scale
+# pipeline, 3 scenes): lr 1e-5 regresses immediately (40 iters -> 12.5%,
+# 150 iters -> 10.4%); lr 1e-6 at 100 iters preserves it exactly (27.1%,
+# median rot 2.75 -> 2.65 deg).  Recipe: from strong baselines stage 3
+# needs a 10x smaller lr than the round-1 weak-baseline recipe; both
+# pipelines' stage-3 lr set accordingly (ref_scale_pipeline.sh).

@@ -47,8 +47,11 @@ python test_esac.py $SCENES --size ref --frames 64 --res $RES \
   --json .ref_eval_stage2_jax.json
 
 echo "=== stage 3: end-to-end ($(date)) ==="
+# lr 1e-6: from STRONG stage-1 baselines, stage-3 at 1e-5 measurably
+# regresses accuracy while 1e-6 preserves-or-improves it
+# (CPU_SCALE_EVAL.json stage3 sweep; experiments/generalization.py notes).
 python train_esac.py $SCENES --size ref --frames 512 --res $RES \
-  --iterations 400 --learningrate 1e-5 --batch 2 --hypotheses 64 \
+  --iterations 400 --learningrate 1e-6 --batch 2 --hypotheses 64 \
   --checkpoint-every 100 $(resume_flag ckpt_ref_esac_state) \
   --experts $EXPERTS --gating ckpt_ref_gating --output ckpt_ref_esac
 

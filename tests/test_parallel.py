@@ -175,3 +175,22 @@ def test_sharded_subsampled_scoring_uses_shared_cells():
         best_scores.append(float(jnp.max(sc)))
     assert int(expert) == int(np.argmax(best_scores)) == 4
     np.testing.assert_allclose(float(score), max(best_scores), rtol=1e-5)
+
+
+def test_sharded_esac_honors_scoring_impl_fused():
+    """scoring_impl="fused" flows through the shard_map path (the scoring
+    helper is shared) and picks the same expert as the default impl."""
+    import dataclasses
+
+    mesh = make_mesh(n_data=1, n_expert=8)
+    coords_all, frame = make_expert_maps(jax.random.key(9), 8, 4)
+    coords_all = jax.device_put(coords_all, expert_sharding(mesh))
+    cfg_fused = dataclasses.replace(CFG, scoring_impl="fused")
+    rvec, tvec, expert, score = esac_infer_sharded(
+        mesh, jax.random.key(10), coords_all, frame["pixels"], F, C, cfg_fused
+    )
+    assert int(expert) == 4
+    r_err, t_err = pose_errors(
+        rodrigues(rvec), tvec, rodrigues(frame["rvec"]), frame["tvec"]
+    )
+    assert r_err < 5.0 and t_err < 0.05
