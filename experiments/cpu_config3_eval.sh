@@ -1,0 +1,52 @@
+#!/bin/sh
+# Config-#3 analogue at CPU scale: MANY experts + gating, evaluated dense,
+# gating-pruned (--topk), and via the gating-drawn C++ loop — evidence that
+# expert routing and pruning preserve accuracy as the ensemble grows
+# (BASELINE.md config #3 is 12 experts x 1024 hyps; this is the 8-expert,
+# CPU-feasible version; the TPU pipeline covers ref scale when the chip
+# serves).  Stage 3 is omitted deliberately: the lr sweep showed it must be
+# gated on eval and it is not what config #3 measures (routing is).
+#
+# Runs entirely on CPU (--cpu): safe alongside TPU jobs.  Resumable.
+set -e
+cd "$(dirname "$0")/.."
+
+SCENES="synth0 synth1 synth2 synth3 synth4 synth5 synth6 synth7"
+EXPERTS=""
+for s in $SCENES; do EXPERTS="$EXPERTS ckpt_cpu_expert_$s"; done
+
+resume_flag() {
+  if [ -d "$1/opt_state" ] || [ -d "$1.old/opt_state" ]; then echo "--resume"; fi
+  return 0
+}
+
+echo "=== config3 stage 1: 8 experts ($(date)) ==="
+for s in $SCENES; do
+  ck="ckpt_cpu_expert_$s"
+  echo "--- expert $s ---"
+  python train_expert.py "$s" --cpu --size test --frames 768 \
+    --iterations 4000 --learningrate 1e-3 --batch 8 \
+    --checkpoint-every 1000 $(resume_flag "$ck") --output "$ck"
+done
+
+echo "=== config3 stage 2: gating over 8 ($(date)) ==="
+python train_gating.py $SCENES --cpu --size test --frames 256 \
+  --iterations 2000 --learningrate 1e-3 --batch 8 \
+  --checkpoint-every 500 $(resume_flag ckpt_cpu_gating8) --output ckpt_cpu_gating8
+
+echo "=== config3 eval: dense (all 8 experts) ($(date)) ==="
+python test_esac.py $SCENES --cpu --size test --frames 8 \
+  --experts $EXPERTS --gating ckpt_cpu_gating8 --hypotheses 64 \
+  --json .cpu_eval_config3_dense.json
+
+echo "=== config3 eval: --topk 2 (gating-pruned) ($(date)) ==="
+python test_esac.py $SCENES --cpu --size test --frames 8 \
+  --experts $EXPERTS --gating ckpt_cpu_gating8 --hypotheses 64 --topk 2 \
+  --json .cpu_eval_config3_topk2.json
+
+echo "=== config3 eval: cpp gating-drawn loop ($(date)) ==="
+python test_esac.py $SCENES --cpu --size test --frames 8 \
+  --experts $EXPERTS --gating ckpt_cpu_gating8 --hypotheses 64 --backend cpp \
+  --json .cpu_eval_config3_cpp.json
+
+echo "=== config3 done ($(date)) ==="
