@@ -39,6 +39,7 @@ import sys
 import time
 
 N_HYPS = 256
+CELLS = 4800        # 80x60 coordinate grid (BASELINE.md config #1)
 BATCH = 16          # frames vmapped per dispatch to saturate the chip
 REPEATS = 20
 STREAM_MESH_CHIPS = 8   # config #5's mesh size; single-device runs measure
@@ -57,12 +58,15 @@ def _measure_jax(
     n_hyps: int = N_HYPS,
     repeats: int = REPEATS,
     shard_data: bool = False,
-) -> float:
+    timing_passes: int = 1,
+) -> float | list[float]:
     """Fenced per-chip throughput of the jax hypothesis pipeline.
 
     With ``shard_data`` the batch axis is sharded over all devices (config #5
     streaming mode); the returned rate is divided by the device count so the
-    metric stays per-chip either way.
+    metric stays per-chip either way.  ``timing_passes > 1`` repeats only the
+    timed loop (one compile, one set of frames) and returns a list of rates —
+    the cheap way to measure run-to-run spread.
     """
     import jax
     import jax.numpy as jnp
@@ -106,12 +110,18 @@ def _measure_jax(
     rkeys = jax.random.split(jax.random.key(1), batch)
     out = fn(rkeys, coords, pixels)
     jax.block_until_ready(out["rvec"])  # compile + warm
-    t0 = time.perf_counter()
-    for i in range(repeats):
-        out = fn(jax.random.split(jax.random.key(2 + i), batch), coords, pixels)
-    jax.block_until_ready(out["rvec"])
-    dt = time.perf_counter() - t0
-    return repeats * batch * n_hyps / dt / n_chips
+    rates = []
+    for p in range(timing_passes):
+        t0 = time.perf_counter()
+        for i in range(repeats):
+            out = fn(
+                jax.random.split(jax.random.key(2 + i + 1000 * p), batch),
+                coords, pixels,
+            )
+        jax.block_until_ready(out["rvec"])
+        dt = time.perf_counter() - t0
+        rates.append(repeats * batch * n_hyps / dt / n_chips)
+    return rates if timing_passes > 1 else rates[0]
 
 
 def _measure_cpp() -> float | None:
@@ -332,6 +342,50 @@ def measure_on_device(
     return None  # orphaned, not killed
 
 
+def _hardware_block(streaming: bool) -> dict | None:
+    """Committed-hardware provenance for the JSON line: the most recent
+    wedge-safe TPU measurement (BENCH_TPU.json), surfaced as structured
+    fields so the driver artifact carries the hardware evidence even when
+    the relay is down at snapshot time.  The top-level "value" stays
+    strictly live-measured; this block is explicitly labeled as committed
+    history, with its recording time and source artifact."""
+    rec = _read_json(_REPO / "BENCH_TPU.json")
+    if rec is None:
+        return None
+    src = rec.get("streaming_config5", {}) if streaming else rec
+    if "value" not in src:
+        return None
+    blk = {
+        "value": src.get("value"),
+        "unit": src.get("unit"),
+        "device_kind": src.get("device_kind"),
+        "recorded_at": rec.get("recorded_at"),
+        "artifact": "BENCH_TPU.json",
+    }
+    if not streaming:
+        blk["vs_baseline"] = rec.get("vs_baseline")
+        if rec.get("baseline_normalization"):
+            blk["baseline_normalization"] = rec["baseline_normalization"]
+    return blk
+
+
+def _measure_jax_cpu_spread(kwargs: dict, n_runs: int = 3) -> tuple[float, dict]:
+    """CPU-fallback measurement with run-to-run spread: the CPU path has
+    ~20% noise on this shared-core container (observed across rounds), so a
+    single sample is not an honest record.  One compile, ``n_runs`` timed
+    passes.  Returns (median rate, spread)."""
+    rates = sorted(_measure_jax(**kwargs, timing_passes=n_runs))
+    median = rates[len(rates) // 2]
+    spread = {
+        "n_runs": n_runs,
+        "min": round(rates[0], 1),
+        "max": round(rates[-1], 1),
+        "note": "CPU-path run-to-run spread on a shared 1-core container; "
+                "value is the median run",
+    }
+    return median, spread
+
+
 def main() -> None:
     if len(sys.argv) > 2 and sys.argv[1] == "--device-child":
         device_child(json.loads(sys.argv[2]))
@@ -344,17 +398,21 @@ def main() -> None:
     # The parent never touches the accelerator: everything below runs on the
     # CPU backend; the device measurement is delegated to a detached child.
     note = None
+    cpu_spread = None
+    hardware = _hardware_block(streaming)
     res = measure_on_device(kwargs)
     if res is None:
         note = (
             "device measurement unavailable (relay wedged or child failed); "
-            "jax path measured on CPU. Hardware numbers for this round are in "
-            "the committed BENCH_TPU.json (TPU v5 lite, wedge-safe protocol)."
+            "jax path measured on CPU."
         )
+        if hardware is not None:
+            note += (" Committed hardware numbers are in the 'hardware' "
+                     "field (source: BENCH_TPU.json).")
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        jax_rate = _measure_jax(**kwargs)
+        jax_rate, cpu_spread = _measure_jax_cpu_spread(kwargs)
     else:
         jax_rate = res["rate"]
         if res.get("platform") == "cpu":
@@ -365,6 +423,19 @@ def main() -> None:
 
         jax.config.update("jax_platforms", "cpu")
 
+    from esac_tpu.utils.profiling import pipeline_flop_summary
+
+    live_on_device = res is not None and res.get("platform") != "cpu"
+    if live_on_device:
+        flop_rate, flop_kind, flop_basis = jax_rate, res.get("device_kind"), "live"
+    elif hardware is not None and hardware.get("value"):
+        # %-of-TPU-peak for a CPU fallback run is meaningless; compute the
+        # utilization figure for the committed hardware rate, labeled so.
+        flop_rate, flop_kind = hardware["value"], hardware.get("device_kind")
+        flop_basis = f"committed ({hardware.get('artifact')})"
+    else:
+        flop_rate, flop_kind, flop_basis = jax_rate, None, "live (cpu)"
+
     if streaming:
         out = {
             "metric": "streaming_hypotheses_per_sec_per_chip",
@@ -372,6 +443,13 @@ def main() -> None:
         }
         if note:
             out["note"] = note
+        if cpu_spread:
+            out["cpu_run_spread"] = cpu_spread
+        if not live_on_device and hardware is not None:
+            out["hardware"] = hardware
+        out["flop_model"] = pipeline_flop_summary(
+            flop_rate, flop_kind, flop_basis, n_cells=CELLS, n_hyps=4096,
+        )
         print(json.dumps(out))
         return
 
@@ -385,8 +463,21 @@ def main() -> None:
     }
     if note:
         out["note"] = note
-    if res is not None and res.get("platform") != "cpu":
+    if cpu_spread:
+        out["cpu_run_spread"] = cpu_spread
+    if live_on_device:
         out["device_kind"] = res.get("device_kind")
+    elif hardware is not None:
+        out["hardware"] = hardware
+    if vs is not None:
+        out["baseline_normalization"] = (
+            "cpp baseline is single-threaded (1-core container); the "
+            "reference extension is OpenMP-parallel, so divide vs_baseline "
+            "by the reference host's core count for a like-for-like ratio"
+        )
+    out["flop_model"] = pipeline_flop_summary(
+        flop_rate, flop_kind, flop_basis, n_cells=CELLS, n_hyps=N_HYPS,
+    )
     print(json.dumps(out))
 
 

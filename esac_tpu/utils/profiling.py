@@ -75,3 +75,98 @@ def trace(log_dir: str = "/tmp/esac_tpu_trace"):
         yield log_dir
     finally:
         jax.profiler.stop_trace()
+
+
+# --- FLOP model of the hypothesis pipeline (VERDICT r2 #4) ---------------
+#
+# Analytic per-stage counts for the inference pipeline that bench.py times
+# (sample -> P3P -> soft-inlier score -> argmax -> IRLS refine).  These are
+# *model* numbers — counted from the op structure of ransac/kernel.py and
+# geometry/, not measured by the compiler — so they answer "what fraction of
+# the chip does this throughput correspond to", which raw hyps/s cannot.
+#
+# Per-stage accounting (f32 flops, counting mul/add/div/exp as 1 each):
+#
+#   score (per hypothesis x per cell), the dominant term:
+#     rodrigues rvec->R is amortized over cells (once per hypothesis);
+#     R@X + t            3x3 matvec + add        = 21
+#     perspective divide + focal/principal scale  =  8
+#     residual vs pixel + squared norm            =  6
+#     sqrt + sigmoid(beta*(tau-r)) + reduce-add   ~ 10
+#                                     ------------------
+#                                     ~45 flops/cell/hyp
+#
+#   minimal P3P solve (per hypothesis): branchless Ferrari quartic +
+#     triad alignment + `polish_iters` Gauss-Newton polish rounds on 4
+#     points — ~1.5k + polish_iters * ~600 flops.
+#
+#   IRLS refine (per refined pose per iteration): residuals + weights over
+#     all cells (~50/cell) + unrolled 6x6 normal-equation solve (~2.5k).
+#     Inference refines only the winner; training refines every hypothesis.
+
+SCORE_FLOPS_PER_CELL = 45.0
+P3P_FLOPS_BASE = 1500.0
+P3P_FLOPS_PER_POLISH = 600.0
+REFINE_FLOPS_PER_CELL_ITER = 50.0
+REFINE_FLOPS_SOLVE = 2500.0
+
+# bf16 MXU peak by device kind (flops/s).  The scoring stage is elementwise
+# f32 on the VPU, not matmul on the MXU, so %-of-MXU-peak is a deliberately
+# conservative utilization figure — it says how far from "the chip's
+# headline number" the pipeline runs, which is the honest denominator for
+# the north-star claim.  (v5e: 197 TFLOP/s bf16 per chip.)
+DEVICE_PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+}
+
+
+def flops_per_hypothesis(
+    n_cells: int,
+    polish_iters: int = 3,
+    refine_iters: int = 8,
+    refined_frac: float = 0.0,
+) -> float:
+    """Model flops for one hypothesis through sample->solve->score, plus
+    ``refined_frac`` of an IRLS refinement (1/n_hyps at inference where only
+    the argmax winner is refined; 1.0 in training expectations)."""
+    solve = P3P_FLOPS_BASE + polish_iters * P3P_FLOPS_PER_POLISH
+    score = n_cells * SCORE_FLOPS_PER_CELL
+    refine = refined_frac * refine_iters * (
+        n_cells * REFINE_FLOPS_PER_CELL_ITER + REFINE_FLOPS_SOLVE
+    )
+    return solve + score + refine
+
+
+def pipeline_flop_summary(
+    hyps_per_sec: float,
+    device_kind: str | None,
+    basis: str = "live",
+    n_cells: int = 4800,
+    n_hyps: int = 256,
+) -> dict:
+    """Effective GFLOP/s (model flops x measured rate) and %-of-peak for the
+    bench artifact.  ``basis`` labels where the rate came from ("live" or a
+    committed-artifact tag) so a reader always knows which measurement the
+    utilization figure describes."""
+    fph = flops_per_hypothesis(n_cells, refined_frac=1.0 / n_hyps)
+    out = {
+        "flops_per_hypothesis_model": round(fph),
+        "assumptions": f"{n_cells} cells scored/hyp at "
+                       f"{SCORE_FLOPS_PER_CELL:.0f} flops/cell; winner-only "
+                       f"IRLS refine amortized 1/{n_hyps}",
+    }
+    eff = hyps_per_sec * fph
+    out["effective_gflops"] = round(eff / 1e9, 1)
+    out["basis"] = basis
+    peak = DEVICE_PEAK_FLOPS.get(device_kind or "")
+    if peak:
+        out["pct_of_bf16_peak"] = round(100.0 * eff / peak, 3)
+        out["device_kind"] = device_kind
+        out["peak_note"] = (
+            "scoring is elementwise f32 on the VPU, not MXU matmul; "
+            "%-of-MXU-bf16-peak is the conservative denominator for the "
+            "north-star claim"
+        )
+    return out

@@ -1,0 +1,50 @@
+#!/bin/sh
+# Round-3 config-#2 accuracy pipeline at the largest CPU-feasible scale
+# (VERDICT r2 "next round" #1): REF-SIZE nets (the same ~10M-param preset the
+# TPU pipeline uses), 4 synthetic scenes, 96x128 renders — the resolution is
+# the only knob reduced from ref_scale_pipeline.sh, sized from a measured
+# 2.1 s/iter on this 1-core container so stages 1+2 fit in ~6h of core time.
+#
+# Runs entirely with --cpu (never touches the relay) under nice so
+# foreground test runs keep priority.  Resumable: every stage passes
+# --checkpoint-every and a relaunch picks up from the last periodic
+# checkpoint.  Stage 3 is NOT here — it runs from r3_stage3.sh once the
+# toy-scale stage-3 recipe investigation (VERDICT #5) picks hyperparameters,
+# against the stage-1/2 checkpoints this script produces.
+set -e
+cd "$(dirname "$0")/.."
+
+SCENES="synth0 synth1 synth2 synth3"
+EXPERTS="ckpt_r3_expert_synth0 ckpt_r3_expert_synth1 ckpt_r3_expert_synth2 ckpt_r3_expert_synth3"
+RES="96 128"
+
+resume_flag() {
+  if [ -d "$1/opt_state" ] || [ -d "$1.old/opt_state" ]; then echo "--resume"; fi
+  return 0
+}
+
+echo "=== r3 stage 1: experts ($(date)) ==="
+for s in $SCENES; do
+  ck="ckpt_r3_expert_$s"
+  echo "--- expert $s ---"
+  python train_expert.py "$s" --cpu --size ref --frames 1024 --res $RES \
+    --iterations 2500 --learningrate 1e-3 --batch 8 \
+    --checkpoint-every 250 $(resume_flag "$ck") --output "$ck"
+done
+
+echo "=== r3 stage 2: gating ($(date)) ==="
+python train_gating.py $SCENES --cpu --size ref --frames 512 --res $RES \
+  --iterations 1500 --learningrate 1e-3 --batch 8 \
+  --checkpoint-every 250 $(resume_flag ckpt_r3_gating) --output ckpt_r3_gating
+
+echo "=== r3 eval stage 2, jax ($(date)) ==="
+python test_esac.py $SCENES --cpu --size ref --frames 48 --res $RES \
+  --experts $EXPERTS --gating ckpt_r3_gating --hypotheses 256 \
+  --json .r3_eval_stage2_jax.json
+
+echo "=== r3 eval stage 2, cpp ($(date)) ==="
+python test_esac.py $SCENES --cpu --size ref --frames 48 --res $RES \
+  --experts $EXPERTS --gating ckpt_r3_gating --hypotheses 256 --backend cpp \
+  --json .r3_eval_stage2_cpp.json
+
+echo "=== r3 stages 1+2 done ($(date)) ==="

@@ -26,6 +26,17 @@
 # that the relay is serving new clients despite the zombies.
 cd "$(dirname "$0")/.."
 
+# Zombie accounting: every ABANDONED probe is a live process stuck awaiting
+# the device (never killed — CLAUDE.md), and each one holds relay state.
+# Accumulation is therefore CAPPED: after MAX_ZOMBIES abandonments the
+# relaunch cadence stretches to one probe per ZOMBIE_COOLDOWN_S (4h), so the
+# worst case is bounded at MAX_ZOMBIES + a few per day instead of 2/hour
+# forever.  The count is logged on every abandonment so an operator can see
+# the population without ps spelunking.
+MAX_ZOMBIES=6
+ZOMBIE_COOLDOWN_S=14400
+ABANDONED=0
+
 launch_probe() {
   rm -f .tpu_probe.json
   python tools/tpu_probe.py > .tpu_probe.log 2>&1 &
@@ -44,6 +55,12 @@ while : ; do
     sleep 300
     launch_probe
   elif [ $PROBE_AGE -ge 1800 ]; then       # probe hung: abandon, try fresh
+    ABANDONED=$((ABANDONED+1))
+    echo "abandoned hung probe pid=$PROBE (zombie #$ABANDONED, $(date))"
+    if [ $ABANDONED -ge $MAX_ZOMBIES ]; then
+      echo "zombie cap reached ($ABANDONED): cooling down ${ZOMBIE_COOLDOWN_S}s"
+      sleep $ZOMBIE_COOLDOWN_S
+    fi
     launch_probe
   fi
 done

@@ -69,11 +69,25 @@ def _lineage_t0() -> float:
     return float(os.environ.get("TPU_PROBE_T0") or time.time())
 
 
+# Only transient relay failures are worth the in-place retry lineage; a
+# deterministic failure (broken install, bad libtpu config, "No jellyfish
+# device found" when the tunnel presents no device) would burn the whole
+# ~19-minute budget before the supervisor sees a dead probe.  Substrings
+# matched case-insensitively against repr(exc).
+TRANSIENT_ERROR_PATTERNS = ("unavailable", "deadline", "socket closed",
+                            "connection reset", "failed to connect")
+
+
 def _retry_or_give_up(exc: Exception) -> None:
     import sys
 
     attempt = _attempt()
     elapsed = time.time() - _lineage_t0()
+    msg = repr(exc).lower()
+    if not any(pat in msg for pat in TRANSIENT_ERROR_PATTERNS):
+        report("error_deterministic", attempt=attempt,
+               elapsed_s=round(elapsed, 1), error=repr(exc)[:300])
+        raise exc  # surface on attempt 1: supervisor relaunches on its cadence
     report("retry_unavailable", attempt=attempt, elapsed_s=round(elapsed, 1),
            error=repr(exc)[:300])
     if (attempt >= MAX_ATTEMPTS
