@@ -14,7 +14,7 @@ Source layout (per scene, e.g. ``chess/``):
     TrainSplit.txt / TestSplit.txt      lines like "sequence1"
 
 Destination: ``<dest>/<scene>/{training,test}/{rgb,poses,calibration,depth}``
-with per-frame focal-length files (7-Scenes: f = 525 px).  Files are
+with per-frame focal-length files (7-Scenes: f = 585 px, see FOCAL).  Files are
 hard-linked when possible to avoid duplicating gigabytes.
 """
 
@@ -26,7 +26,13 @@ import pathlib
 import sys
 
 SCENES = ("chess", "fire", "heads", "office", "pumpkin", "redkitchen", "stairs")
-FOCAL = 525.0
+# 7-Scenes ships no explicit intrinsics; the published convention for the
+# Kinect v1 these sequences were captured with is f = 585 px at 640x480 with
+# the principal point at the image center — and the GT scene coordinates are
+# rendered from the DEPTH stream, whose intrinsics that 585 describes.
+# (Some scene-coordinate-regression releases instead use the PrimeSense RGB
+# default 525; pass --focal to reproduce those.)
+FOCAL = 585.0
 
 
 def _link(src: pathlib.Path, dst: pathlib.Path) -> None:
@@ -41,7 +47,8 @@ def _link(src: pathlib.Path, dst: pathlib.Path) -> None:
         shutil.copy2(src, dst)
 
 
-def convert_scene(source: pathlib.Path, dest: pathlib.Path, scene: str) -> int:
+def convert_scene(source: pathlib.Path, dest: pathlib.Path, scene: str,
+                  focal: float = FOCAL) -> int:
     sdir = source / scene
     n = 0
     for split_file, split in (("TrainSplit.txt", "training"), ("TestSplit.txt", "test")):
@@ -65,7 +72,7 @@ def convert_scene(source: pathlib.Path, dest: pathlib.Path, scene: str) -> int:
                     _link(depth, out / "depth" / f"{stem}.png")
                 calib = out / "calibration" / f"{stem}.txt"
                 calib.parent.mkdir(parents=True, exist_ok=True)
-                calib.write_text(f"{FOCAL}\n")
+                calib.write_text(f"{focal}\n")
                 n += 1
     return n
 
@@ -75,13 +82,17 @@ def main(argv=None) -> int:
     p.add_argument("--source", required=True, help="downloaded 7-Scenes root")
     p.add_argument("--dest", default="datasets/7scenes")
     p.add_argument("--scenes", nargs="*", default=list(SCENES))
+    p.add_argument("--focal", type=float, default=FOCAL,
+                   help="focal length written to calibration/ (585 = Kinect "
+                        "depth convention; 525 reproduces the PrimeSense-RGB "
+                        "convention some releases use)")
     args = p.parse_args(argv)
     source, dest = pathlib.Path(args.source), pathlib.Path(args.dest)
     for scene in args.scenes:
         if not (source / scene).is_dir():
             print(f"skip {scene}: not found under {source}")
             continue
-        n = convert_scene(source, dest, scene)
+        n = convert_scene(source, dest, scene, focal=args.focal)
         print(f"{scene}: {n} frames")
     return 0
 

@@ -107,7 +107,11 @@ class SceneDataset:
             if depth_path is not None:
                 from PIL import Image as PImage
 
-                depth = np.asarray(PImage.open(depth_path), dtype=np.float32) / 1000.0
+                depth = np.asarray(PImage.open(depth_path), dtype=np.float32)
+                # Kinect invalid-depth sentinel (7-Scenes: 65535) -> 0, the
+                # loader's no-measurement value, BEFORE mm->m conversion.
+                depth[depth >= 65535.0] = 0.0
+                depth /= 1000.0
                 coords = self._coords_from_depth(depth, T.reshape(4, 4), focal, image.shape)
         return Frame(image, rvec, tvec, focal, coords, self.expert)
 

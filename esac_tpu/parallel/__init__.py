@@ -21,7 +21,10 @@ see PARALLELISM.md at the repo root for the explicit mapping.
 """
 
 from esac_tpu.parallel.mesh import make_mesh, expert_sharding, batch_sharding
-from esac_tpu.parallel.esac_sharded import esac_infer_sharded
+from esac_tpu.parallel.esac_sharded import (
+    esac_infer_routed, esac_infer_sharded, pad_experts_for_mesh,
+    pad_gating_logits,
+)
 from esac_tpu.parallel.multihost import initialize_multihost
 from esac_tpu.parallel.train_sharded import make_sharded_esac_loss, shard_esac_params
 
@@ -29,8 +32,11 @@ __all__ = [
     "make_mesh",
     "expert_sharding",
     "batch_sharding",
+    "esac_infer_routed",
     "esac_infer_sharded",
     "initialize_multihost",
     "make_sharded_esac_loss",
+    "pad_experts_for_mesh",
+    "pad_gating_logits",
     "shard_esac_params",
 ]

@@ -7,6 +7,26 @@ and the globally best pose is selected by an argmax all-reduce over the
 global expert index, ``lax.psum`` of the masked winner pose.  This is the
 single real cross-chip collective of the workload (SURVEY.md §2), expressed
 with ``shard_map`` so the communication pattern is explicit and rides ICI.
+
+Two inference paths:
+
+- ``esac_infer_sharded`` — dense: every device scores ALL of its local
+  experts' coordinate maps.  Right for small M (the all-experts consensus
+  strictly dominates subset selection) and for callers that precompute the
+  coordinate stack.
+- ``esac_infer_routed`` — gating-routed (SURVEY.md §2 EP row: "gating routes
+  each query image to device-local experts"; §7 hard part #3): each device
+  runs the expert CNN forwards for only its top-``capacity`` local experts
+  by gating mass — static-shaped, MoE-capacity-style.  This is the sparse
+  compute the gating network exists to buy (at Aachen's M=50 the dense path
+  spends ~M/ (D*capacity) times the necessary expert compute per frame).
+  Semantics match ``ransac.esac.esac_infer_topk``: consensus argmax over
+  the evaluated subset; a gating miss (true expert not selected) fails the
+  frame, exactly as the reference's drawn-subset policy can.  Capacity
+  overflow — more than ``capacity`` of the global top experts colocated on
+  one device — drops the overflow experts (the MoE capacity trade), which
+  is the one divergence from global top-k and is surfaced via the returned
+  ``experts_evaluated``.
 """
 
 from __future__ import annotations
@@ -23,6 +43,21 @@ from esac_tpu.ransac.kernel import _split_score_key
 from esac_tpu.ransac.refine import refine_soft_inliers
 
 
+def _winner_allreduce(local_score, g_expert, rvec, tvec, M, axis="expert"):
+    """The argmax all-reduce: pmax the score over ``axis``, break ties toward
+    the smallest global expert index, psum the winner-masked pose.  The one
+    real cross-chip collective of the workload — shared by the dense and
+    routed paths so selection semantics cannot diverge.  Works elementwise
+    over any leading batch shape (scores (…,), poses (…, 3))."""
+    best = jax.lax.pmax(local_score, axis)
+    tie = jnp.where(local_score >= best, g_expert, M)
+    win = jax.lax.pmin(tie, axis)
+    is_w = (g_expert == win).astype(rvec.dtype)[..., None]
+    rvec_g = jax.lax.psum(rvec * is_w, axis)
+    tvec_g = jax.lax.psum(tvec * is_w, axis)
+    return rvec_g, tvec_g, win, best
+
+
 def esac_infer_sharded(
     mesh: Mesh,
     key: jax.Array,
@@ -31,11 +66,19 @@ def esac_infer_sharded(
     f: jnp.ndarray,
     c: jnp.ndarray,
     cfg: RansacConfig = RansacConfig(),
+    gating_logits: jnp.ndarray | None = None,
 ):
     """Sharded multi-expert inference. coords_all: (M, N, 3), M divisible by
     the mesh's ``expert`` axis size.  Returns (rvec, tvec, expert, score) —
     replicated on all devices.
+
+    ``gating_logits`` (M,), replicated: accepted for surface parity with the
+    single-chip ``esac_infer`` — selection stays consensus-by-score over ALL
+    experts (which strictly dominates gated subsets when everything is
+    computed anyway); callers that want gating to PRUNE compute use
+    ``esac_infer_routed``.
     """
+    del gating_logits  # consensus path: reported upstream, not used here
     n_exp_shards = mesh.shape["expert"]
     M = coords_all.shape[0]
     if M % n_exp_shards != 0:
@@ -70,14 +113,180 @@ def esac_infer_sharded(
         local_score = scores[mi, j]
         global_expert = shard_id * m_local + mi
 
-        # Argmax all-reduce over the expert axis: pmax the score, break ties
-        # toward the smallest expert index, psum the masked winner.
-        best_score = jax.lax.pmax(local_score, "expert")
-        tie_idx = jnp.where(local_score >= best_score, global_expert, M)
-        win_idx = jax.lax.pmin(tie_idx, "expert")
-        is_winner = (global_expert == win_idx).astype(rvec.dtype)
-        rvec_g = jax.lax.psum(rvec * is_winner, "expert")
-        tvec_g = jax.lax.psum(tvec * is_winner, "expert")
-        return rvec_g, tvec_g, win_idx, best_score
+        return _winner_allreduce(local_score, global_expert, rvec, tvec, M)
 
     return jax.jit(body)(key, coords_all, pixels)
+
+
+def pad_experts_for_mesh(e_stack, centers, n_shards: int):
+    """Pad stacked expert params / scene centers so the expert count divides
+    ``n_shards``.
+
+    Padding repeats expert 0's params (cheapest valid tree); pad the gating
+    logits per batch with :func:`pad_gating_logits` — ``esac_infer_routed``
+    masks slots whose logit is -inf out of the score argmax, so a padded
+    expert can be *selected* into a slot (when a shard holds fewer real
+    experts than ``capacity``) but can never win.  Returns
+    (e_stack, centers, M_padded).
+    """
+    M = centers.shape[0]
+    M_pad = ((M + n_shards - 1) // n_shards) * n_shards
+    extra = M_pad - M
+    if extra == 0:
+        return e_stack, centers, M
+    e_stack = jax.tree.map(
+        lambda x: jnp.concatenate(
+            [x, jnp.repeat(x[:1], extra, axis=0)], axis=0
+        ),
+        e_stack,
+    )
+    centers = jnp.concatenate(
+        [centers, jnp.repeat(centers[:1], extra, axis=0)], axis=0
+    )
+    return e_stack, centers, M_pad
+
+
+def pad_gating_logits(logits: jnp.ndarray, M_pad: int) -> jnp.ndarray:
+    """Pad the last (expert) axis of gating logits to ``M_pad`` with -inf —
+    the per-batch companion of :func:`pad_experts_for_mesh` (params/centers
+    are padded once; logits are produced per batch by the gating net)."""
+    extra = M_pad - logits.shape[-1]
+    if extra == 0:
+        return logits
+    pad = jnp.full(logits.shape[:-1] + (extra,), -jnp.inf, logits.dtype)
+    return jnp.concatenate([logits, pad], axis=-1)
+
+
+def esac_infer_routed(
+    mesh: Mesh,
+    expert_apply,
+    e_stack,
+    centers: jnp.ndarray,
+    capacity: int,
+    cfg: RansacConfig = RansacConfig(),
+):
+    """Build the gating-routed sharded inference function (config #4).
+
+    ``expert_apply(params, images) -> (B, h, w, 3)`` is the expert network
+    forward; ``e_stack`` is the stacked param tree with leading axis M
+    (divisible by the mesh's expert axis — use :func:`pad_experts_for_mesh`),
+    ``centers`` (M, 3) the per-expert scene centers, ``capacity`` the static
+    number of local experts each device runs per frame.
+
+    Returns ``infer(key, gating_logits, images, focals, pixels, c) -> dict``
+    where ``gating_logits`` is (B, M) and ``images`` (B, H, W, 3), both
+    replicated, ``focals`` (B,) per-frame focal lengths, ``pixels`` the
+    (N, 2) output-cell pixel grid and ``c`` the (2,) principal point; the
+    result dict (all replicated) has:
+
+    - ``rvec``/``tvec``: (B, 3) winning refined poses,
+    - ``expert``: (B,) winning global expert index,
+    - ``score``: (B,) winning soft-inlier score,
+    - ``experts_evaluated``: (B, n_shards * capacity) global indices of the
+      experts whose CNN actually ran for each frame — the compute-tracking
+      record (gating misses and capacity drops are visible here).
+
+    Per-frame expert compute is ``n_shards * capacity`` CNN forwards instead
+    of M.  Scoring stays cross-shard comparable: the score-cell subsample key
+    is split BEFORE the per-shard fold, as in ``esac_infer_sharded``.
+    """
+    n_shards = mesh.shape["expert"]
+    M = centers.shape[0]
+    if M % n_shards != 0:
+        raise ValueError(
+            f"M={M} not divisible by expert shards {n_shards}; "
+            "pad with pad_experts_for_mesh"
+        )
+    m_local = M // n_shards
+    cap = min(capacity, m_local)
+
+    e_specs = jax.tree.map(lambda _: P("expert"), e_stack)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), e_specs, P("expert"), P(), P()),
+        out_specs=(P(), P(), P(), P(), P()),
+    )
+    def body(k, logits_B, images_B, focals_B, e_local, centers_local, px,
+             c_pt):
+        shard_id = jax.lax.axis_index("expert")
+        k_hyp, k_sub = _split_score_key(k, cfg)
+        k_shard = jax.random.fold_in(k_hyp, shard_id)
+
+        def one_frame(args):
+            fi, logits, image, focal = args
+            g = jax.nn.softmax(logits)  # (M,) — padded entries exactly 0
+            g_local = jax.lax.dynamic_slice(
+                g, (shard_id * m_local,), (m_local,)
+            )
+            l_local = jax.lax.dynamic_slice(
+                logits, (shard_id * m_local,), (m_local,)
+            )
+            _, top_local = jax.lax.top_k(g_local, cap)
+            # Padding detector: ONLY pad_gating_logits' -inf entries are
+            # ineligible to win.  A real expert whose softmax mass underflows
+            # to exact zero (logit gap > ~88 in f32) stays eligible — its
+            # consensus score decides, matching esac_infer_topk, which has
+            # no mass cutoff.
+            is_real = jnp.isfinite(l_local[top_local])
+            # Only the selected experts' CNNs run — the routed sparsity.
+            params_c = jax.tree.map(lambda x: x[top_local], e_local)
+            centers_c = centers_local[top_local]
+            coords_c = jax.lax.map(
+                lambda pc: expert_apply(pc[0], image[None])[0] + pc[1],
+                (params_c, centers_c),
+            )  # (cap, h, w, 3)
+            coords_c = coords_c.reshape(cap, -1, 3)
+            k_frame = jax.random.fold_in(k_shard, fi)
+            rvecs, tvecs, scores = _per_expert_hypotheses(
+                k_frame, coords_c, px, focal, c_pt, cfg, score_key=k_sub,
+            )  # (cap, nh, 3), (cap, nh)
+            # Padding slots (a shard with fewer real experts than capacity)
+            # must not win on consensus score.
+            scores = jnp.where(is_real[:, None], scores, -jnp.inf)
+            flat = jnp.argmax(scores.reshape(-1))
+            mi, j = flat // scores.shape[1], flat % scores.shape[1]
+            rvec, tvec = refine_soft_inliers(
+                rvecs[mi, j], tvecs[mi, j], coords_c[mi], px, focal, c_pt,
+                cfg.tau, cfg.beta, iters=cfg.refine_iters,
+            )
+            return (rvec, tvec, scores[mi, j],
+                    shard_id * m_local + top_local[mi],
+                    shard_id * m_local + top_local)
+
+        B = images_B.shape[0]
+        rvec, tvec, local_score, g_expert, evaluated = jax.lax.map(
+            one_frame,
+            (jnp.arange(B), logits_B, images_B, focals_B),
+        )  # (B,3) (B,3) (B,) (B,) (B,cap)
+
+        # Batched argmax all-reduce over the expert axis (elementwise on B).
+        rvec_g, tvec_g, win, best = _winner_allreduce(
+            local_score, g_expert, rvec, tvec, M
+        )
+        # Assemble the per-frame evaluated sets via a scatter + psum (the
+        # psum output is statically replicated, which the VMA check accepts
+        # where an all_gather's output is not inferred as such).
+        slots = jnp.zeros((B, n_shards, evaluated.shape[1]), evaluated.dtype)
+        slots = jax.lax.dynamic_update_slice(
+            slots, evaluated[:, None, :], (0, shard_id, 0)
+        )
+        evaluated_all = jax.lax.psum(slots, "expert").reshape(B, -1)
+        return rvec_g, tvec_g, win, best, evaluated_all
+
+    jit_body = jax.jit(body)
+
+    def infer(key, gating_logits, images, focals, pixels, c):
+        rvec, tvec, expert, score, evaluated = jit_body(
+            key, gating_logits, images, focals, e_stack, centers, pixels, c
+        )
+        return {
+            "rvec": rvec,
+            "tvec": tvec,
+            "expert": expert,
+            "score": score,
+            "experts_evaluated": evaluated,
+        }
+
+    return infer

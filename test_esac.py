@@ -42,6 +42,19 @@ def main(argv=None) -> int:
     p.add_argument("--limit", type=int, default=0, help="max frames per scene (0 = all)")
     p.add_argument("--topk", type=int, default=0,
                    help="evaluate only the top-k gating experts (0 = all, dense)")
+    p.add_argument("--sharded", action="store_true",
+                   help="shard the experts over all devices and run the "
+                        "gating-routed config-#4 inference path (expert CNNs "
+                        "run only for gating-selected experts; winning pose "
+                        "by cross-shard argmax all-reduce)")
+    p.add_argument("--capacity", type=int, default=0,
+                   help="with --sharded: gating-selected local experts run "
+                        "per device per frame (0 = all local experts, i.e. "
+                        "dense-sharded through the same routed path)")
+    p.add_argument("--devices", type=int, default=0,
+                   help="with --sharded --cpu: number of virtual CPU devices "
+                        "to build the mesh over (0 = whatever the process "
+                        "has; the driver/test harness may preset this)")
     p.add_argument("--eval-batch", type=int, default=16,
                    help="frames per jitted dispatch; evaluation is O(batches) "
                         "device round-trips, not O(frames) — the per-dispatch "
@@ -52,6 +65,19 @@ def main(argv=None) -> int:
                         "readable artifact for accuracy tables)")
     args = p.parse_args(argv)
     maybe_force_cpu(args)
+    if args.sharded and args.backend != "jax":
+        p.error("--sharded is a jax-backend mode")
+    if args.sharded and args.topk:
+        p.error("--sharded and --topk are mutually exclusive; use --capacity "
+                "for gating-pruned compute on the mesh")
+    if args.sharded and args.devices > 0:
+        if not args.cpu:
+            p.error("--devices requires --cpu (virtual CPU device mesh)")
+        try:
+            jax.config.update("jax_num_cpu_devices", args.devices)
+        except Exception as e:  # backend already initialized
+            if jax.device_count() < args.devices:
+                p.error(f"cannot provide {args.devices} devices: {e}")
 
     datasets = [
         open_scene(args.root, s, "test", expert=i, **scene_kwargs(args))
@@ -103,6 +129,40 @@ def main(argv=None) -> int:
         )
     infer_jax = jax.jit(jax.vmap(one))
 
+    routed = gating_only = M_pad = n_evaluated = None
+    if args.sharded:
+        # Config #4: experts sharded over the mesh, expert CNNs run only for
+        # the gating-selected local experts (esac_infer_routed docstring).
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from esac_tpu.parallel import (
+            esac_infer_routed, make_mesh, pad_experts_for_mesh,
+            pad_gating_logits,
+        )
+
+        n_dev = jax.device_count()
+        mesh = make_mesh(n_data=1, n_expert=n_dev)
+        e_stack_p, e_centers_p, M_pad = pad_experts_for_mesh(
+            e_stack, e_centers, n_dev
+        )
+        e_stack_p = jax.device_put(
+            e_stack_p,
+            jax.tree.map(lambda _: NamedSharding(mesh, P("expert")), e_stack_p),
+        )
+        m_local = M_pad // n_dev
+        cap = min(args.capacity, m_local) if args.capacity > 0 else m_local
+        # Padding slots run a (wasted, static-shape) forward but are not
+        # real experts: cap the reported evaluated count at M so the
+        # bookkeeping never claims more experts than exist.
+        n_evaluated = min(n_dev * cap, M)
+        routed = esac_infer_routed(
+            mesh, e_net.apply, e_stack_p, e_centers_p, capacity=cap, cfg=cfg
+        )
+        gating_only = jax.jit(lambda images: gating.apply(g_params, images))
+        pad_logits_fn = jax.jit(
+            lambda lg: pad_gating_logits(lg, M_pad)
+        )
+
     # Stage all frames host-side, then evaluate in fixed-size batches: one
     # dispatch per batch for the networks and one for the hypothesis loop.
     frames = []
@@ -123,10 +183,28 @@ def main(argv=None) -> int:
         pad = np.pad(sel, (0, B - len(sel)), mode="edge")  # static batch shape
         images = jnp.asarray(images_h[pad])
         focals = jnp.asarray(focals_h[pad])
-        logits, coords_all = predict_coords(images)
-        jax.block_until_ready(coords_all)
-        t0 = time.perf_counter()
-        if args.backend == "jax":
+        if args.sharded:
+            # Routed path: the gating forward is the only dense network
+            # compute; expert CNNs run inside the routed dispatch for the
+            # selected experts only, so the timed section includes them
+            # (unlike the dense path, whose expert forwards are excluded
+            # from the timer below) — the honest cost of routed inference.
+            logits = gating_only(images)
+            jax.block_until_ready(logits)
+            t0 = time.perf_counter()
+            out = routed(
+                jax.random.key(start), pad_logits_fn(logits), images,
+                focals, pixels, cx,
+            )
+            jax.block_until_ready(out["rvec"])
+            dt = (time.perf_counter() - t0) / len(pad)
+            R_b = jax.vmap(rodrigues)(out["rvec"])
+            t_b = out["tvec"]
+            experts = np.asarray(out["expert"])
+        elif args.backend == "jax":
+            logits, coords_all = predict_coords(images)
+            jax.block_until_ready(coords_all)
+            t0 = time.perf_counter()
             keys = jax.vmap(jax.random.key)(jnp.asarray(pad))
             out = infer_jax(keys, logits, coords_all, focals)
             jax.block_until_ready(out["rvec"])
@@ -140,6 +218,9 @@ def main(argv=None) -> int:
             # dense path's hypotheses * M.
             from esac_tpu.backends import esac_infer_gated_cpp
 
+            logits, coords_all = predict_coords(images)
+            jax.block_until_ready(coords_all)
+            t0 = time.perf_counter()
             co_np, px_np = np.asarray(coords_all), np.asarray(pixels)
             gating_np = np.asarray(jax.nn.softmax(logits, axis=-1))
             Rs, ts, experts = [], [], []
@@ -171,8 +252,13 @@ def main(argv=None) -> int:
     print(f"median trans err: {100 * np.median(tr):.2f} cm")
     print(f"5cm/5deg:         {100.0 * ok / n_total:.1f}%")
     print(f"expert accuracy:  {100.0 * expert_ok / n_total:.1f}%")
+    n_hyp_experts = (n_evaluated if args.sharded
+                     else min(args.topk, M) if args.topk > 0 else M)
+    mode = (f", sharded routed ({n_evaluated}/{M} experts/frame)"
+            if args.sharded else "")
     print(f"median time:      {1e3 * np.median(tm):.1f} ms/frame "
-          f"({args.hypotheses * M} hyps, backend={args.backend})")
+          f"({args.hypotheses * n_hyp_experts} hyps, "
+          f"backend={args.backend}{mode})")
     if args.json:
         import json
 
@@ -186,7 +272,12 @@ def main(argv=None) -> int:
                 "pct_5cm5deg": round(100.0 * ok / n_total, 2),
                 "expert_accuracy_pct": round(100.0 * expert_ok / n_total, 2),
                 "median_ms_per_frame": round(1e3 * float(np.median(tm)), 2),
-                "hypotheses_total": args.hypotheses * M,
+                "hypotheses_total": args.hypotheses * n_hyp_experts,
+                **({"sharded": True,
+                    "devices": jax.device_count(),
+                    "capacity": cap,  # effective per-device capacity
+                    "experts_evaluated_per_frame": n_evaluated,
+                    "experts_total": M} if args.sharded else {}),
             }, fh, indent=2)
         print(f"wrote {args.json}")
     return 0

@@ -1,0 +1,146 @@
+"""Golden-vector convention tests for the real-dataset path (VERDICT r2 #6).
+
+Every fixture here is hand-encoded from the PUBLISHED 7-Scenes format facts
+(MSR release): TUM-style 4x4 camera-to-world pose text, uint16 depth PNGs in
+millimeters with 65535 = invalid, 640x480 Kinect frames with f = 585 px and
+the principal point at the image center.  The expected values are literal
+arithmetic written out from those specs — NOT produced by this repo's code —
+so a silent m/mm flip, pose-direction flip, focal change, or principal-point
+slip fails these tests even though every self-consistency test would pass.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+from esac_tpu.data.datasets import SceneDataset  # noqa: E402
+
+# Hand-written camera-to-world pose: the camera sits at (1, 2, 3) in the
+# scene frame, rotated +90 deg about z (camera x maps to world y).
+T_CW_TEXT = """\
+0 -1 0 1
+1 0 0 2
+0 0 1 3
+0 0 0 1
+"""
+
+# Spec constants (7-Scenes / Kinect v1).
+F = 585.0
+W, H = 640, 480
+CX, CY = 320.0, 240.0  # principal point = image center
+STRIDE = 8             # stride-8 output grid, cell centers at 4 + 8k
+
+
+def _write_scene(root: pathlib.Path, depth_mm: np.ndarray) -> None:
+    """Common-layout scene with ONE frame, fabricated byte-by-byte."""
+    d = root / "golden" / "training"
+    (d / "rgb").mkdir(parents=True)
+    (d / "poses").mkdir()
+    (d / "calibration").mkdir()
+    (d / "depth").mkdir()
+    Image.fromarray(np.zeros((H, W, 3), np.uint8)).save(d / "rgb" / "f0.png")
+    (d / "poses" / "f0.txt").write_text(T_CW_TEXT)
+    (d / "calibration" / "f0.txt").write_text(f"{F}\n")
+    Image.fromarray(depth_mm.astype(np.uint16)).save(d / "depth" / "f0.png")
+
+
+def _golden_frame(tmp_path):
+    # Uniform 1000 mm background; cell (r=30, c=40) -> pixel (324, 244) gets
+    # 2000 mm; two invalid sentinels: 0 at cell (0,0), 65535 at cell (0,1).
+    depth = np.full((H, W), 1000, np.int64)
+    depth[244, 324] = 2000
+    depth[4, 4] = 0
+    depth[4, 12] = 65535
+    _write_scene(tmp_path, depth)
+    ds = SceneDataset(tmp_path, "golden", "training", coord_stride=STRIDE)
+    return ds[0]
+
+
+def test_pose_text_is_camera_to_world(tmp_path):
+    """Frame.rvec/tvec must be the INVERSE of the on-disk pose: R = R_cw^T,
+    t = -R_cw^T @ c.  By hand: R_cw = rot_z(+90deg), c = (1,2,3) gives
+    t = (-2, 1, -3) and rvec = (0, 0, -pi/2).  A loader that forgets the
+    inversion returns t = (1, 2, 3) instead."""
+    fr = _golden_frame(tmp_path)
+    np.testing.assert_allclose(fr.tvec, [-2.0, 1.0, -3.0], atol=1e-5)
+    np.testing.assert_allclose(fr.rvec, [0.0, 0.0, -np.pi / 2], atol=1e-5)
+
+
+def test_depth_is_millimeters_backprojected_at_585(tmp_path):
+    """Golden scene coordinate, all arithmetic from the spec:
+
+    pixel (324, 244), depth 2000 mm = 2.0 m (a mm/m flip gives 2000 m):
+      cam = ((324-320)/585 * 2, (244-240)/585 * 2, 2)
+          = (8/585, 8/585, 2.0)
+      world = R_cw @ cam + (1, 2, 3)
+            = (1 - 8/585, 2 + 8/585, 5.0)
+    """
+    fr = _golden_frame(tmp_path)
+    assert fr.coords_gt is not None and fr.coords_gt.shape == (60, 80, 3)
+    e = 8.0 / 585.0
+    np.testing.assert_allclose(
+        fr.coords_gt[30, 40], [1.0 - e, 2.0 + e, 5.0], atol=1e-5
+    )
+    # Background cell (r=10, c=20) -> pixel (164, 84), depth 1.0 m:
+    #   cam = ((164-320)/585, (84-240)/585, 1) = (-156/585, -156/585, 1)
+    #   world = (1 + 156/585, 2 - 156/585, 4.0)
+    b = 156.0 / 585.0
+    np.testing.assert_allclose(
+        fr.coords_gt[10, 20], [1.0 + b, 2.0 - b, 4.0], atol=1e-5
+    )
+
+
+def test_invalid_depth_sentinels_mask_to_zero(tmp_path):
+    """7-Scenes invalid depths — 0 AND the Kinect 65535 sentinel — must
+    produce the (0,0,0) no-measurement coordinate, not a 65.5 m point."""
+    fr = _golden_frame(tmp_path)
+    np.testing.assert_array_equal(fr.coords_gt[0, 0], [0.0, 0.0, 0.0])
+    np.testing.assert_array_equal(fr.coords_gt[0, 1], [0.0, 0.0, 0.0])
+    # ... and a neighboring valid cell is NOT masked: cell (0,2) has depth
+    # 1.0 m, so its z in the world frame is 3.0 + 1.0 = 4.0.
+    assert abs(fr.coords_gt[0, 2][2] - 4.0) < 1e-5
+
+
+def test_converter_writes_spec_focal_and_passes_pose_through(tmp_path):
+    """setup_7scenes must write the published 585 default focal and copy the
+    camera-to-world pose text UNCHANGED (the inversion happens at load time,
+    exactly once)."""
+    src = tmp_path / "raw" / "chess" / "seq-01"
+    src.mkdir(parents=True)
+    Image.fromarray(np.zeros((H, W, 3), np.uint8)).save(
+        src / "frame-000000.color.png"
+    )
+    (src / "frame-000000.pose.txt").write_text(T_CW_TEXT)
+    Image.fromarray(np.full((H, W), 1500, np.uint16)).save(
+        src / "frame-000000.depth.png"
+    )
+    (tmp_path / "raw" / "chess" / "TrainSplit.txt").write_text("sequence1\n")
+    (tmp_path / "raw" / "chess" / "TestSplit.txt").write_text("sequence1\n")
+    dest = tmp_path / "out"
+    r = subprocess.run(
+        [sys.executable, str(REPO / "datasets" / "setup_7scenes.py"),
+         "--source", str(tmp_path / "raw"), "--dest", str(dest),
+         "--scenes", "chess"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr
+    calib = (dest / "chess" / "training" / "calibration" /
+             "seq01-frame-000000.txt").read_text()
+    assert float(calib) == 585.0
+    pose = (dest / "chess" / "training" / "poses" /
+            "seq01-frame-000000.txt").read_text()
+    np.testing.assert_array_equal(
+        np.fromstring(pose, sep=" "), np.fromstring(T_CW_TEXT, sep=" ")
+    )
+    # And the loaded frame back-projects 1500 mm to z_world = 3.0 + 1.5.
+    ds = SceneDataset(dest, "chess", "training", coord_stride=STRIDE)
+    fr = ds[0]
+    assert abs(fr.coords_gt[30, 40][2] - 4.5) < 1e-5

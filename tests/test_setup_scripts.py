@@ -53,7 +53,7 @@ def test_setup_7scenes_roundtrip(tmp_path):
     assert fr.image.shape == (16, 24, 3)
     assert fr.coords_gt is not None and fr.coords_gt.shape == (2, 3, 3)
     assert np.isfinite(fr.coords_gt).all()
-    assert fr.focal == 525.0
+    assert fr.focal == 585.0  # the Kinect depth-intrinsics convention
 
 
 def test_setup_aachen_clusters(tmp_path):

@@ -48,6 +48,15 @@ def main(argv=None) -> int:
     p.add_argument("--alpha", type=float, default=0.5,
                    help="softmax selection temperature over hypothesis scores "
                         "(0.5 per the round-1 sweep: sharp selection trains best)")
+    p.add_argument("--alpha-start", type=float, default=None,
+                   help="two-phase selection-sharpness anneal: use this alpha "
+                        "for the first half of training, then switch to "
+                        "--alpha (soft early selection spreads gradient over "
+                        "more hypotheses; one retrace at the switch)")
+    p.add_argument("--clip-norm", type=float, default=0.0,
+                   help="optax global-norm gradient clip (0 = off); the "
+                        "pose-loss gradient through IRLS refinement can "
+                        "spike on near-degenerate hypotheses")
     p.add_argument("--loss-clamp", type=float, default=100.0,
                    help="per-hypothesis pose-loss clamp (deg-equivalent)")
     p.add_argument("--output", default="ckpt_esac")
@@ -89,6 +98,8 @@ def main(argv=None) -> int:
     cfg = RansacConfig(n_hyps=args.hypotheses, train_refine_iters=1,
                        alpha=args.alpha, loss_clamp=args.loss_clamp,
                        scoring_impl=args.scoring_impl)
+    if args.alpha_start is not None and args.backend == "cpp":
+        p.error("--alpha-start is a jax-backend option")
     cx = jnp.asarray([W / 2.0, H / 2.0])
 
     cpp_losses = None
@@ -106,7 +117,16 @@ def main(argv=None) -> int:
             p.error("--backend cpp requested but the C++ backend is unavailable")
         cpp_losses = make_cpp_expert_losses(pixels, float(f0.focal), (W / 2.0, H / 2.0), cfg)
 
-    opt = optax.adam(args.learningrate)
+    # The clip stage is ALWAYS in the chain (inf = no-op) so the opt_state
+    # pytree structure is identical with and without --clip-norm — a resume
+    # template must not depend on the flag, or toggling it across a resume
+    # fails the checkpoint restore with an opaque structure mismatch.
+    opt = optax.chain(
+        optax.clip_by_global_norm(
+            args.clip_norm if args.clip_norm > 0 else float("inf")
+        ),
+        optax.adam(args.learningrate),
+    )
     opt_state = opt.init((e_stack, g_params))
 
     start_it = 0
@@ -118,41 +138,60 @@ def main(argv=None) -> int:
         e_stack = jax.tree.map(jnp.asarray, e_stack)
         print(f"resumed {args.output}_state at iteration {start_it}")
 
-    @jax.jit
-    def train_step(params, opt_state, key, images, R_gts, t_gts, focal):
-        def loss_fn(ps):
-            e_ps, g_p = ps
-            logits = gating.apply(g_p, images)  # (B, M)
-            coords = jax.lax.map(
-                lambda pc: e_net.apply(pc[0], images) + pc[1],
-                (e_ps, e_centers),
-            )  # (M, B, h, w, 3)
-            B = images.shape[0]
-            coords = jnp.moveaxis(coords, 0, 1).reshape(B, M, -1, 3)
-            keys = jax.random.split(key, B)
-            if cpp_losses is not None:
-                from esac_tpu.ransac.sampling import sample_correspondence_sets
+    def make_train_step(step_cfg):
+        @jax.jit
+        def train_step(params, opt_state, key, images, R_gts, t_gts, focal):
+            def loss_fn(ps):
+                e_ps, g_p = ps
+                logits = gating.apply(g_p, images)  # (B, M)
+                coords = jax.lax.map(
+                    lambda pc: e_net.apply(pc[0], images) + pc[1],
+                    (e_ps, e_centers),
+                )  # (M, B, h, w, 3)
+                B = images.shape[0]
+                coords = jnp.moveaxis(coords, 0, 1).reshape(B, M, -1, 3)
+                keys = jax.random.split(key, B)
+                if cpp_losses is not None:
+                    from esac_tpu.ransac.sampling import sample_correspondence_sets
 
-                def frame_loss(k, lg, ca, Rg, tg):
-                    idx = sample_correspondence_sets(
-                        k, cfg.n_hyps * M, ca.shape[1]
-                    ).reshape(M, cfg.n_hyps, 4)
-                    E = cpp_losses(ca, Rg, tg, idx)
-                    return jnp.sum(jax.nn.softmax(lg) * E)
+                    def frame_loss(k, lg, ca, Rg, tg):
+                        idx = sample_correspondence_sets(
+                            k, step_cfg.n_hyps * M, ca.shape[1]
+                        ).reshape(M, step_cfg.n_hyps, 4)
+                        E = cpp_losses(ca, Rg, tg, idx)
+                        return jnp.sum(jax.nn.softmax(lg) * E)
 
-                losses = jax.vmap(frame_loss)(keys, logits, coords, R_gts, t_gts)
-            else:
-                losses, _ = jax.vmap(
-                    lambda k, lg, ca, Rg, tg: esac_train_loss(
-                        k, lg, ca, pixels, focal, cx, Rg, tg, cfg, args.estimator
-                    )
-                )(keys, logits, coords, R_gts, t_gts)
-            return jnp.mean(losses)
+                    losses = jax.vmap(frame_loss)(keys, logits, coords, R_gts, t_gts)
+                else:
+                    losses, _ = jax.vmap(
+                        lambda k, lg, ca, Rg, tg: esac_train_loss(
+                            k, lg, ca, pixels, focal, cx, Rg, tg, step_cfg,
+                            args.estimator
+                        )
+                    )(keys, logits, coords, R_gts, t_gts)
+                return jnp.mean(losses)
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return train_step
+
+    train_step = make_train_step(cfg)
+    # Two-phase selection-sharpness anneal (--alpha-start): a soft first
+    # half spreads the selection gradient over more hypotheses, then the
+    # sharp --alpha takes over.  Piecewise-constant because alpha lives in
+    # the STATIC RansacConfig — a per-iteration traced alpha would retrace
+    # every step; two cfgs cost exactly one extra compile at the switch.
+    alpha_switch_it = args.iterations // 2
+    train_step_early = None
+    if args.alpha_start is not None:
+        import dataclasses
+
+        train_step_early = make_train_step(
+            dataclasses.replace(cfg, alpha=args.alpha_start)
+        )
 
     # Stage all scenes on device once (see train_expert.py).
     staged = [batch_frames(d, np.arange(len(d))) for d in datasets]
@@ -172,7 +211,10 @@ def main(argv=None) -> int:
         if it < start_it:  # fast-forward the data stream on resume
             continue
         idx = jnp.asarray(idx)
-        params, opt_state, loss = train_step(
+        step_fn = (train_step_early
+                   if train_step_early is not None and it < alpha_switch_it
+                   else train_step)
+        params, opt_state, loss = step_fn(
             params, opt_state, jax.random.key(args.seed * 7919 + it),
             images_d[idx], R_gts_d[idx], tvecs_d[idx], focal,
         )
