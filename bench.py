@@ -34,6 +34,7 @@ from __future__ import annotations
 import json
 import os
 import pathlib
+import signal
 import subprocess
 import sys
 import time
@@ -386,10 +387,124 @@ def _measure_jax_cpu_spread(kwargs: dict, n_runs: int = 3) -> tuple[float, dict]
     return median, spread
 
 
+def _pause_pipelines() -> tuple[list[int], list[float]]:
+    """SIGSTOP the repo's own background compute queues for the duration of
+    the measurement (VERDICT r3 weak #1/#7: round-3's CPU value recorded
+    core contention from a detached training pipeline, not throughput).
+
+    Targets are (a) process groups recorded in .pipeline.pid by
+    experiments/r4_queue.sh-style queues, and (b) any orphaned trainer
+    (train_expert/train_gating/train_esac.py) that is explicitly --cpu.
+    Only --cpu work is ever paused: a SIGSTOP is not a kill, but a stopped
+    process *holding the TPU relay* would still stall the device child, and
+    pausing an unknown TPU client is not this file's call to make.  The
+    caller must SIGCONT everything returned (try/finally in main).
+    """
+    pgids: set[int] = set()
+    try:
+        for tok in (_REPO / ".pipeline.pid").read_text().split():
+            if _pid_running(int(tok)):
+                pgids.add(os.getpgid(int(tok)))
+    except Exception:
+        pass
+    pgids |= _orphan_trainer_pgids()
+    pgids.discard(os.getpgid(0))  # never our own group
+    # Enforce the CPU-only invariant on every candidate group, including
+    # pidfile ones — a stale/foreign pidfile must not let bench SIGSTOP a
+    # process that could be holding the TPU relay.  Rejecting a group only
+    # costs a contended measurement (recorded in loadavg); pausing a relay
+    # holder could stall the device child against a stopped owner.
+    pgids = {pg for pg in pgids if _pgid_cpu_only(pg)}
+    load_before = [round(x, 2) for x in os.getloadavg()]
+    stopped = []
+    for pg in sorted(pgids):
+        try:
+            os.killpg(pg, signal.SIGSTOP)
+            stopped.append(pg)
+        except Exception:
+            pass
+    return stopped, load_before
+
+
+def _pgid_cpu_only(pgid: int) -> bool:
+    """True iff every *python* process in the group carries an explicit
+    --cpu flag (non-python members — sh, sleep, tee — are fine).  This is
+    deliberately conservative: a queue briefly running a stdlib-only tool
+    without --cpu makes the group unpausable for that moment, which merely
+    costs contention; the invariant it buys is that bench never stops a
+    possible TPU-relay client."""
+    found_any = False
+    for proc in pathlib.Path("/proc").iterdir():
+        if not proc.name.isdigit():
+            continue
+        try:
+            if os.getpgid(int(proc.name)) != pgid:
+                continue
+            cmd = (proc / "cmdline").read_bytes().decode().replace("\0", " ")
+        except Exception:
+            continue
+        found_any = True
+        if "python" in cmd.split(" ")[0] and "--cpu" not in cmd:
+            return False
+    return found_any
+
+
+def _orphan_trainer_pgids() -> set[int]:
+    """Process groups of --cpu trainers not covered by a .pipeline.pid (a
+    resumed expert whose queue shell died, for example)."""
+    pgids: set[int] = set()
+    for proc in pathlib.Path("/proc").iterdir():
+        if not proc.name.isdigit():
+            continue
+        try:
+            cmd = (proc / "cmdline").read_bytes().decode().replace("\0", " ")
+        except Exception:
+            continue
+        if ("--cpu" in cmd and any(
+                s in cmd for s in ("train_expert.py", "train_gating.py",
+                                   "train_esac.py"))):
+            try:
+                pgids.add(os.getpgid(int(proc.name)))
+            except Exception:
+                pass
+    return pgids
+
+
+def _resume_pipelines(stopped: list[int]) -> None:
+    for pg in stopped:
+        try:
+            os.killpg(pg, signal.SIGCONT)
+        except Exception:
+            pass
+
+
+def _contention_block(stopped: list[int], load_before: list[float]) -> dict:
+    """Honesty record for the JSON line: what was running on this 1-core
+    container, what was paused, and the load average (1/5/15 min) before the
+    pause — the field that explains cross-round CPU drift (r01 11.6k ->
+    r02 9.5k -> r03 2.9k was contention, invisible in the artifact)."""
+    return {
+        "loadavg_prepause": load_before,
+        "paused_pipeline_pgids": stopped,
+        "note": "repo background pipelines are SIGSTOPped during "
+                "measurement and resumed after; loadavg is 1/5/15-min "
+                "pre-pause (>~1.0 on this 1-core box means the value "
+                "would have recorded contention without the pause)",
+    }
+
+
 def main() -> None:
     if len(sys.argv) > 2 and sys.argv[1] == "--device-child":
         device_child(json.loads(sys.argv[2]))
         return
+    stopped, load_before = _pause_pipelines()
+    try:
+        _main_measured(stopped, load_before)
+    finally:
+        _resume_pipelines(stopped)
+
+
+def _main_measured(stopped: list[int], load_before: list[float]) -> None:
     streaming = len(sys.argv) > 1 and sys.argv[1] == "streaming"
     kwargs = (
         dict(batch=STREAM_BATCH, n_hyps=4096, repeats=5, shard_data=True)
@@ -423,6 +538,7 @@ def main() -> None:
 
         jax.config.update("jax_platforms", "cpu")
 
+    from esac_tpu.ransac import RansacConfig
     from esac_tpu.utils.profiling import pipeline_flop_summary
 
     live_on_device = res is not None and res.get("platform") != "cpu"
@@ -449,7 +565,9 @@ def main() -> None:
             out["hardware"] = hardware
         out["flop_model"] = pipeline_flop_summary(
             flop_rate, flop_kind, flop_basis, n_cells=CELLS, n_hyps=4096,
+            scoring_impl=RansacConfig().scoring_impl,
         )
+        out["contention"] = _contention_block(stopped, load_before)
         print(json.dumps(out))
         return
 
@@ -477,7 +595,9 @@ def main() -> None:
         )
     out["flop_model"] = pipeline_flop_summary(
         flop_rate, flop_kind, flop_basis, n_cells=CELLS, n_hyps=N_HYPS,
+        scoring_impl=RansacConfig().scoring_impl,
     )
+    out["contention"] = _contention_block(stopped, load_before)
     print(json.dumps(out))
 
 

@@ -32,6 +32,12 @@ SCENES = ("chess", "fire", "heads", "office", "pumpkin", "redkitchen", "stairs")
 # rendered from the DEPTH stream, whose intrinsics that 585 describes.
 # (Some scene-coordinate-regression releases instead use the PrimeSense RGB
 # default 525; pass --focal to reproduce those.)
+#
+# NOTE: this default changed 525 -> 585 in round 3.  Trees converted before
+# that keep per-frame 525 calibration files — regenerate them (the loader
+# warns when it reads 525), and never compare accuracy numbers across the
+# two conventions: reference-convention releases that assume 525 are not
+# directly comparable to 585-converted evals.
 FOCAL = 585.0
 
 

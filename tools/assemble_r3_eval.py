@@ -1,0 +1,75 @@
+#!/usr/bin/env python3
+"""Assemble the committed config-#2 accuracy table (R3_SCALE_EVAL.json).
+
+Pulls together the three pieces of evidence the acceptance config asks for
+(SURVEY.md §6; BASELINE.md config #2) from the pipeline's own artifacts:
+
+  * stage-1 per-expert final coord L1s  — from the training logs
+    (.r3_pipeline.log from round 3, .r4_queue.log from the round-4 queue);
+  * stage-2 gating final CE             — same logs;
+  * dual-backend test_esac evals        — .r3_eval_stage2_{jax,cpp}.json.
+
+Pure stdlib on purpose: this runs inside the compute queue and must never
+initialize a jax backend (CLAUDE.md environment hazards).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+LOGS = [ROOT / ".r3_pipeline.log", ROOT / ".r4_queue.log"]
+SCENES = ["synth0", "synth1", "synth2"]
+
+
+def scan_logs():
+    """Last 'saved <ckpt> final <unit> <loss>' per checkpoint across logs."""
+    finals: dict[str, float] = {}
+    pat = re.compile(r"saved (ckpt_r3_\w+)\s+final (?:coord L1|CE) ([0-9.]+)")
+    for log in LOGS:
+        if not log.exists():
+            continue
+        for m in pat.finditer(log.read_text()):
+            finals[m.group(1)] = float(m.group(2))
+    return finals
+
+
+def main() -> int:
+    finals = scan_logs()
+    evals = {}
+    for backend in ("jax", "cpp"):
+        p = ROOT / f".r3_eval_stage2_{backend}.json"
+        if p.exists():
+            evals[backend] = json.loads(p.read_text())
+
+    missing = [s for s in SCENES if f"ckpt_r3_expert_{s}" not in finals]
+    out = {
+        "config": "#2 (BASELINE.md): multi-expert ESAC at ref-size nets",
+        "setup": {
+            "scenes": SCENES,
+            "note": "3 scenes per VERDICT r3 #1 re-size guidance (measured "
+                    "~3.6 s/iter made the 4-scene plan infeasible on this "
+                    "1-core container); ref-size (~10M-param) experts, "
+                    "96x128 renders, 2500 iters/expert, 1500 gating iters, "
+                    "48 test frames/scene, 256 hyps/expert, all --cpu",
+        },
+        "stage1_final_coord_l1": {
+            s: finals.get(f"ckpt_r3_expert_{s}") for s in SCENES
+        },
+        "stage2_gating_final_ce": finals.get("ckpt_r3_gating"),
+        "eval": evals,
+        "complete": not missing and "jax" in evals and "cpp" in evals,
+    }
+    if missing:
+        out["missing_experts"] = missing
+    path = ROOT / "R3_SCALE_EVAL.json"
+    path.write_text(json.dumps(out, indent=2) + "\n")
+    print(f"wrote {path} (complete={out['complete']})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,73 @@
+#!/usr/bin/env python3
+"""Winner-agreement between two test_esac.py --json artifacts.
+
+The config-#4 claim is not that routed inference is *accurate in absolute
+terms* at a toy training budget — it is that routing PRESERVES the dense
+path's answer while running a fraction of the expert CNNs (VERDICT r3 #4 /
+missing #5).  That is a frame-by-frame comparison: same scenes, same frame
+order, same batch keys, winner expert equal or not.
+
+    python tools/eval_agreement.py .ep50_routed.json .ep50_dense.json \
+        -o .ep50_agreement.json
+
+Pure stdlib; never imports jax (CLAUDE.md environment hazards).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def agreement(a: dict, b: dict) -> dict:
+    if a.get("scenes") != b.get("scenes") or a.get("frames") != b.get("frames"):
+        raise SystemExit("artifacts cover different scenes/frame counts — "
+                         "winner agreement is only defined frame-by-frame")
+    ea = a["per_frame"]["expert"]
+    eb = b["per_frame"]["expert"]
+    if len(ea) != len(eb):
+        raise SystemExit(f"per-frame lengths differ: {len(ea)} vs {len(eb)}")
+    n = len(ea)
+    same = sum(x == y for x, y in zip(ea, eb))
+    # Pose-level agreement: frames where both runs land in the same error
+    # regime (both <5cm/5deg or both not) — looser than winner equality
+    # (two experts can both localize a frame if their maps overlap).
+    hit = lambda art, i: (art["per_frame"]["rot_err_deg"][i] < 5.0  # noqa: E731
+                          and art["per_frame"]["trans_err_cm"][i] < 5.0)
+    pose_same = sum(hit(a, i) == hit(b, i) for i in range(n))
+    return {
+        "n_frames": n,
+        "winner_agreement_pct": round(100.0 * same / n, 2),
+        "pose_regime_agreement_pct": round(100.0 * pose_same / n, 2),
+        "a": {"artifact": a.get("_path"), "expert_accuracy_pct":
+              a.get("expert_accuracy_pct"), "pct_5cm5deg": a.get("pct_5cm5deg")},
+        "b": {"artifact": b.get("_path"), "expert_accuracy_pct":
+              b.get("expert_accuracy_pct"), "pct_5cm5deg": b.get("pct_5cm5deg")},
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("a")
+    p.add_argument("b")
+    p.add_argument("-o", "--output", default=None)
+    args = p.parse_args(argv)
+    arts = []
+    for path in (args.a, args.b):
+        with open(path) as fh:
+            d = json.load(fh)
+        d["_path"] = path
+        arts.append(d)
+    out = agreement(*arts)
+    text = json.dumps(out, indent=2)
+    if args.output:
+        with open(args.output, "w") as fh:
+            fh.write(text + "\n")
+        print(f"wrote {args.output}")
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
