@@ -18,7 +18,10 @@ from __future__ import annotations
 
 import json
 import pathlib
+import sys
 import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 import jax
 

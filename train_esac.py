@@ -10,6 +10,13 @@ the expected pose loss through sampling/PnP/scoring/selection/refinement.
 
 ``--estimator dense`` (default) is the exact-gating-gradient TPU path;
 ``--estimator sampled`` is the reference-parity REINFORCE estimator.
+
+Fine-tune recipe (measured, S3_RECIPE.md): from a strong stage-1/2
+baseline use ``--clip-norm 1.0 --learningrate 3e-6 --alpha-start 0.1``
+and gate on eval — lr 1e-5 regresses even with clipping (without clipping
+it collapses), and stage-3 checkpoints should only replace stage-2 ones
+when ``test_esac.py`` improves.  Both estimators share this lr
+sensitivity; ``sampled`` trains as stably as ``dense`` under clip.
 """
 
 from __future__ import annotations
