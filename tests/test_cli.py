@@ -124,3 +124,35 @@ def test_train_esac_resume(pipeline_ckpts):
     from esac_tpu.utils.checkpoint import load_checkpoint
 
     assert load_checkpoint(d / "esac_r_state")[1]["iteration"] == 4
+
+
+def test_train_esac_sharded_routed(pipeline_ckpts, tmp_path):
+    """Config #4's training entry through the real CLI: experts sharded
+    over a virtual mesh, gating-routed per-frame capacity (round 4)."""
+    d = pipeline_ckpts
+    out = run(
+        "train_esac.py", "synth0", "synth1", "--cpu", "--size", "test",
+        "--frames", "4", "--experts", str(d / "e0"), str(d / "e1"),
+        "--gating", str(d / "g"), "--hypotheses", "4", "--batch", "1",
+        "--iterations", "1", "--sharded", "--devices", "4", "--capacity", "1",
+        "--checkpoint-every", "0", "--output", str(tmp_path / "s"),
+    )
+    assert "sharded training: 4 devices, M=2 (+2 pad), capacity=1" in out
+    assert "E[pose loss]" in out
+    assert (tmp_path / "s_gating").is_dir()
+    assert (tmp_path / "s_expert1").is_dir()
+
+
+def test_train_esac_sharded_rejects_sampled(pipeline_ckpts, tmp_path):
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, str(REPO / "train_esac.py"), "synth0", "synth1",
+         "--cpu", "--size", "test", "--experts", "x", "y", "--gating", "g",
+         "--sharded", "--estimator", "sampled",
+         "--output", str(tmp_path / "s")],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert r.returncode != 0
+    assert "dense estimator" in r.stderr

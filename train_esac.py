@@ -66,11 +66,45 @@ def main(argv=None) -> int:
                         "spike on near-degenerate hypotheses")
     p.add_argument("--loss-clamp", type=float, default=100.0,
                    help="per-hypothesis pose-loss clamp (deg-equivalent)")
+    p.add_argument("--sharded", action="store_true",
+                   help="train with experts sharded over all devices "
+                        "(config #4's EP training path: local experts per "
+                        "shard, cross-shard combine through differentiable "
+                        "shard_map)")
+    p.add_argument("--capacity", type=int, default=0,
+                   help="with --sharded: per-frame top-capacity local "
+                        "experts run (gating-routed training, no coordinate "
+                        "all_gather); 0 = dense (all local experts + "
+                        "all_gather)")
+    p.add_argument("--devices", type=int, default=0,
+                   help="with --sharded --cpu: number of virtual CPU "
+                        "devices for the mesh (0 = all)")
     p.add_argument("--output", default="ckpt_esac")
     args = p.parse_args(argv)
     maybe_force_cpu(args)
     if len(args.experts) != len(args.scenes):
         p.error("need one --experts checkpoint per scene")
+    if not args.sharded and (args.capacity or args.devices):
+        p.error("--capacity/--devices only apply with --sharded (without "
+                "it this would silently train the plain dense path)")
+    if args.capacity < 0:
+        p.error("--capacity must be >= 0")
+    if args.sharded:
+        if args.backend != "jax":
+            p.error("--sharded is a jax-backend mode")
+        if args.estimator != "dense":
+            p.error("--sharded trains the dense estimator (the sampled/"
+                    "REINFORCE draw has no per-device top-k structure)")
+        if args.alpha_start is not None:
+            p.error("--alpha-start with --sharded is not supported yet")
+        if args.devices > 0:
+            if not args.cpu:
+                p.error("--devices requires --cpu (virtual CPU device mesh)")
+            try:
+                jax.config.update("jax_num_cpu_devices", args.devices)
+            except Exception as e:  # backend already initialized
+                if jax.device_count() < args.devices:
+                    p.error(f"cannot provide {args.devices} devices: {e}")
 
     datasets = [
         open_scene(args.root, s, "training", expert=i, **scene_kwargs(args))
@@ -124,6 +158,35 @@ def main(argv=None) -> int:
             p.error("--backend cpp requested but the C++ backend is unavailable")
         cpp_losses = make_cpp_expert_losses(pixels, float(f0.focal), (W / 2.0, H / 2.0), cfg)
 
+    mesh = expert_shim = gating_shim = None
+    if args.sharded:
+        # Config #4's EP training entry: experts sharded over the mesh,
+        # optionally gating-routed (--capacity).  Padding repeats expert 0
+        # with -inf gating logits (zero mass -> zero value AND zero grads),
+        # so the padded slots are inert; NOTE the padded stack lives in the
+        # optimizer state, so --resume requires the same device count.
+        import types
+
+        from esac_tpu.parallel import (
+            make_mesh, make_sharded_esac_loss, pad_experts_for_mesh,
+            pad_gating_logits,
+        )
+
+        devs = jax.devices()[: args.devices] if args.devices > 0 else None
+        n_dev = len(devs) if devs is not None else jax.device_count()
+        mesh = make_mesh(n_data=1, n_expert=n_dev, devices=devs)
+        e_stack, e_centers, M_pad = pad_experts_for_mesh(
+            e_stack, e_centers, n_dev
+        )
+        expert_shim = types.SimpleNamespace(
+            apply=lambda pc, im: e_net.apply(pc[0], im) + pc[1]
+        )
+        gating_shim = types.SimpleNamespace(
+            apply=lambda gp, im: pad_gating_logits(gating.apply(gp, im), M_pad)
+        )
+        print(f"sharded training: {n_dev} devices, M={M} (+{M_pad - M} pad), "
+              f"capacity={args.capacity or 'dense'}")
+
     # The clip stage is ALWAYS in the chain (inf = no-op) so the opt_state
     # pytree structure is identical with and without --clip-norm — a resume
     # template must not depend on the flag, or toggling it across a resume
@@ -143,6 +206,15 @@ def main(argv=None) -> int:
             f"{args.output}_state", opt_state
         )
         e_stack = jax.tree.map(jnp.asarray, e_stack)
+        if args.sharded:
+            loaded_M = jax.tree.leaves(e_stack)[0].shape[0]
+            if loaded_M != e_centers.shape[0]:
+                p.error(
+                    f"resumed expert stack is {loaded_M} wide (padded for "
+                    f"its original mesh) but this run pads to "
+                    f"{e_centers.shape[0]}: --sharded --resume requires "
+                    "the same device count as the original run"
+                )
         print(f"resumed {args.output}_state at iteration {start_it}")
 
     def make_train_step(step_cfg):
@@ -184,6 +256,32 @@ def main(argv=None) -> int:
             return params, opt_state, loss
 
         return train_step
+
+    if args.sharded:
+        def make_train_step(step_cfg):  # noqa: F811 — sharded override
+            loss_sharded = make_sharded_esac_loss(
+                mesh, expert_shim, gating_shim, (e_stack, e_centers),
+                g_params, pixels, jnp.float32(f0.focal), cx, step_cfg,
+                "dense", capacity=args.capacity or None,
+            )
+
+            @jax.jit
+            def train_step(params, opt_state, key, images, R_gts, t_gts,
+                           focal):
+                del focal  # sharded loss closes over the staged focal
+
+                def loss_fn(ps):
+                    e_ps, g_p = ps
+                    return loss_sharded(
+                        (e_ps, e_centers), g_p, images, R_gts, t_gts, key
+                    )
+
+                loss, grads = jax.value_and_grad(loss_fn)(params)
+                updates, opt_state2 = opt.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state2, loss
+
+            return train_step
 
     train_step = make_train_step(cfg)
     # Two-phase selection-sharpness anneal (--alpha-start): a soft first
