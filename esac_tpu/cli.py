@@ -22,10 +22,16 @@ from esac_tpu.models import ExpertNet, GatingNet
 # "test" is sized for CPU smoke runs and CI.
 EXPERT_PRESETS = {
     "ref": dict(stem_channels=(64, 128, 256), head_channels=512, head_depth=4),
+    "small": dict(stem_channels=(32, 64, 128), head_channels=256, head_depth=3),
     "test": dict(stem_channels=(16, 32, 64), head_channels=64, head_depth=2),
 }
 GATING_PRESETS = {
     "ref": dict(channels=(32, 64, 128, 256)),
+    # Between test and ref: enough capacity for many-way (~50-scene)
+    # routing at toy resolutions without ref's depth (which collapsed to
+    # uniform logits at 48x64 / lr 1e-3 in the ep50 runs — see
+    # experiments/ep50_gating_v2.sh header).
+    "small": dict(channels=(16, 32, 64)),
     "test": dict(channels=(8, 16)),
 }
 

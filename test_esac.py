@@ -189,6 +189,18 @@ def main(argv=None) -> int:
     # by construction and the field is null.
     rot_errs, trans_errs, times, hyp_times, ok, expert_ok = [], [], [], [], 0, 0
     winners: list[int] = []
+    # Gating-quality counters, separate from the consensus winner: top-1
+    # (does the gate rank the true expert first) and evaluated-set recall
+    # (did the true expert's CNN run at all — for routed/topk the direct
+    # measure of whether the routing budget kept the answer in play; 100%
+    # by construction for dense).  "expert accuracy" alone conflates gate
+    # quality with expert-map quality: a perfect gate still loses the
+    # consensus argmax to a garbage map that happens to score high.
+    gate_top1 = 0
+    recall_hits = 0
+    # cpp's gated loop draws experts per hypothesis — no fixed evaluated
+    # set exists, so recall is undefined there (mode-constant, known here).
+    recall_defined = args.backend != "cpp"
     B = max(1, args.eval_batch)
     for start in range(0, n_total, B):
         sel = np.arange(start, min(start + B, n_total))
@@ -209,6 +221,7 @@ def main(argv=None) -> int:
             R_b = jax.vmap(rodrigues)(out["rvec"])
             t_b = out["tvec"]
             experts = np.asarray(out["expert"])
+            ev_sets = np.asarray(out["experts_evaluated"])
         elif args.backend == "jax":
             t_full = time.perf_counter()
             logits, coords_all = predict_coords(images)
@@ -223,6 +236,8 @@ def main(argv=None) -> int:
             R_b = jax.vmap(rodrigues)(out["rvec"])
             t_b = out["tvec"]
             experts = np.asarray(out["expert"])
+            ev_sets = (np.asarray(out["experts_evaluated"])
+                       if args.topk > 0 else None)  # None = all M ran
         else:
             # Gating-faithful loop (SURVEY.md §0 step 1): hypotheses drawn
             # from the gating distribution, total budget matching the jax
@@ -249,13 +264,23 @@ def main(argv=None) -> int:
             R_b = jnp.asarray(np.stack(Rs), jnp.float32)
             t_b = jnp.asarray(np.stack(ts), jnp.float32)
             experts = np.asarray(experts)
+            ev_sets = "na"  # per-hypothesis categorical draw: no fixed set
         r_errs, t_errs = jax.vmap(pose_errors)(R_b, t_b, R_gts[pad], t_gts[pad])
+        # (B, M) in every branch: sharded pads logits only on the copy fed
+        # to the routed dispatch, never on this one.
+        logits_np = np.asarray(logits)
         for j, gi in enumerate(sel):
             r_err, t_err = float(r_errs[j]), float(t_errs[j])
             rot_errs.append(r_err)
             trans_errs.append(t_err)
             ok += bool(r_err < 5.0 and t_err < 0.05)
-            expert_ok += int(experts[j]) == int(labels_h[gi])
+            label = int(labels_h[gi])
+            expert_ok += int(experts[j]) == label
+            gate_top1 += int(np.argmax(logits_np[j])) == label
+            if ev_sets is None:
+                recall_hits += 1  # dense: every expert ran
+            elif not isinstance(ev_sets, str):
+                recall_hits += label in ev_sets[j]
             winners.append(int(experts[j]))
             times.append(dt)
             if dt_hyp is not None:
@@ -269,6 +294,10 @@ def main(argv=None) -> int:
     print(f"median trans err: {100 * np.median(tr):.2f} cm")
     print(f"5cm/5deg:         {100.0 * ok / n_total:.1f}%")
     print(f"expert accuracy:  {100.0 * expert_ok / n_total:.1f}%")
+    print(f"gating top-1:     {100.0 * gate_top1 / n_total:.1f}%")
+    if recall_defined:
+        print(f"evaluated recall: {100.0 * recall_hits / n_total:.1f}%  "
+              "(true expert's CNN ran)")
     n_hyp_experts = (n_evaluated if args.sharded
                      else min(args.topk, M) if args.topk > 0 else M)
     mode = (f", sharded routed ({n_evaluated}/{M} experts/frame)"
@@ -288,6 +317,10 @@ def main(argv=None) -> int:
                 "median_trans_cm": round(100 * float(np.median(tr)), 3),
                 "pct_5cm5deg": round(100.0 * ok / n_total, 2),
                 "expert_accuracy_pct": round(100.0 * expert_ok / n_total, 2),
+                "gating_top1_pct": round(100.0 * gate_top1 / n_total, 2),
+                "evaluated_recall_pct": (
+                    round(100.0 * recall_hits / n_total, 2)
+                    if recall_defined else None),
                 "median_ms_per_frame": round(1e3 * float(np.median(tm)), 2),
                 "timing_scope": "full pipeline: gating + expert CNN "
                                 "forwards + hypothesis loop, all modes "

@@ -128,3 +128,40 @@ def test_open_scene_noncontiguous_synth_labels_by_position():
     assert int(b["labels"].max()) < len(scenes)
     # Direct construction without an expert override keeps the sid label.
     assert SyntheticScene("synth3", n_frames=2)[0].expert == 3
+
+
+def test_loader_warns_once_on_pre_585_calibration(tmp_path):
+    """Trees converted before setup_7scenes' 525->585 focal change keep 525
+    calibration files; the loader must flag the convention mismatch loudly,
+    once per dataset (ADVICE r3)."""
+    import warnings
+
+    from PIL import Image
+
+    from esac_tpu.data.datasets import SceneDataset
+
+    d = tmp_path / "old" / "training"
+    (d / "rgb").mkdir(parents=True)
+    (d / "poses").mkdir()
+    (d / "calibration").mkdir()
+    Image.fromarray(np.zeros((16, 16, 3), np.uint8)).save(d / "rgb" / "f0.png")
+    (d / "poses" / "f0.txt").write_text(
+        "1 0 0 0\n0 1 0 0\n0 0 1 0\n0 0 0 1\n"
+    )
+    (d / "calibration" / "f0.txt").write_text("525.0\n")
+    ds = SceneDataset(tmp_path, "old", "training")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        ds[0]
+        ds[0]  # second access: no second warning
+    msgs = [str(x.message) for x in w if "525" in str(x.message)]
+    assert len(msgs) == 1
+    assert "Regenerate" in msgs[0]
+
+    # A 585 tree stays silent.
+    (d / "calibration" / "f0.txt").write_text("585.0\n")
+    ds2 = SceneDataset(tmp_path, "old", "training")
+    with warnings.catch_warnings(record=True) as w2:
+        warnings.simplefilter("always")
+        ds2[0]
+    assert not [x for x in w2 if "525" in str(x.message)]
