@@ -264,7 +264,7 @@ def main(argv=None) -> int:
             R_b = jnp.asarray(np.stack(Rs), jnp.float32)
             t_b = jnp.asarray(np.stack(ts), jnp.float32)
             experts = np.asarray(experts)
-            ev_sets = "na"  # per-hypothesis categorical draw: no fixed set
+            ev_sets = None  # recall_defined=False already excludes cpp
         r_errs, t_errs = jax.vmap(pose_errors)(R_b, t_b, R_gts[pad], t_gts[pad])
         # (B, M) in every branch: sharded pads logits only on the copy fed
         # to the routed dispatch, never on this one.
@@ -277,10 +277,10 @@ def main(argv=None) -> int:
             label = int(labels_h[gi])
             expert_ok += int(experts[j]) == label
             gate_top1 += int(np.argmax(logits_np[j])) == label
-            if ev_sets is None:
-                recall_hits += 1  # dense: every expert ran
-            elif not isinstance(ev_sets, str):
-                recall_hits += label in ev_sets[j]
+            if recall_defined:
+                # ev_sets None = dense (every expert ran); else the routed/
+                # topk evaluated set — padded indices are >= M, never a label.
+                recall_hits += 1 if ev_sets is None else label in ev_sets[j]
             winners.append(int(experts[j]))
             times.append(dt)
             if dt_hyp is not None:

@@ -1,0 +1,84 @@
+"""Tests for the stdlib-only artifact tools (no jax import — these run in
+milliseconds and guard the round artifacts' provenance chain)."""
+
+import json
+import sys
+import pathlib
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+
+import eval_agreement
+
+
+def _art(experts, rot, trans, scenes=("a", "b"), **kw):
+    return {
+        "scenes": list(scenes),
+        "frames": len(experts),
+        "per_frame": {
+            "expert": list(experts),
+            "rot_err_deg": list(rot),
+            "trans_err_cm": list(trans),
+        },
+        **kw,
+    }
+
+
+def test_agreement_counts_matching_winners():
+    a = _art([1, 2, 3, 4], [1, 1, 10, 10], [1, 1, 99, 99])
+    b = _art([1, 2, 0, 0], [1, 1, 1, 1], [1, 1, 1, 1])
+    out = eval_agreement.agreement(a, b)
+    assert out["n_frames"] == 4
+    assert out["winner_agreement_pct"] == 50.0
+    # a hits 5cm/5deg on frames 0,1 only; b on all four -> regimes agree on 2.
+    assert out["pose_regime_agreement_pct"] == 50.0
+
+
+def test_agreement_rejects_mismatched_scenes():
+    a = _art([1], [1.0], [1.0], scenes=("a",))
+    b = _art([1], [1.0], [1.0], scenes=("b",))
+    try:
+        eval_agreement.agreement(a, b)
+    except SystemExit as e:
+        assert "frame-by-frame" in str(e)
+    else:
+        raise AssertionError("mismatched scenes must be rejected")
+
+
+def test_agreement_rejects_mismatched_lengths():
+    a = _art([1, 2], [1, 1], [1, 1])
+    b = _art([1], [1], [1])
+    b["frames"] = 2  # lie in the header; per_frame is still length 1
+    try:
+        eval_agreement.agreement(a, b)
+    except SystemExit as e:
+        assert "lengths differ" in str(e)
+    else:
+        raise AssertionError("length mismatch must be rejected")
+
+
+def test_assemble_r3_eval_scans_both_logs(tmp_path, monkeypatch):
+    import assemble_r3_eval as asm
+
+    monkeypatch.setattr(asm, "ROOT", tmp_path)
+    monkeypatch.setattr(
+        asm, "LOGS", [tmp_path / "a.log", tmp_path / "b.log"]
+    )
+    (tmp_path / "a.log").write_text(
+        "saved ckpt_r3_expert_synth0  final coord L1 0.05\n"
+        "saved ckpt_r3_expert_synth1  final coord L1 0.9\n"
+    )
+    # Later log wins for the same checkpoint (resumed run's final value).
+    (tmp_path / "b.log").write_text(
+        "saved ckpt_r3_expert_synth1  final coord L1 0.04\n"
+        "saved ckpt_r3_gating  final CE 0.1\n"
+    )
+    (tmp_path / ".r3_eval_stage2_jax.json").write_text(
+        json.dumps({"pct_5cm5deg": 20.0})
+    )
+    asm.main()
+    out = json.loads((tmp_path / "R3_SCALE_EVAL.json").read_text())
+    assert out["stage1_final_coord_l1"]["synth0"] == 0.05
+    assert out["stage1_final_coord_l1"]["synth1"] == 0.04
+    assert out["stage2_gating_final_ce"] == 0.1
+    assert out["complete"] is False  # synth2 + cpp eval missing
+    assert out["missing_experts"] == ["synth2"]
