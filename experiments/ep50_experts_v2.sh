@@ -1,0 +1,54 @@
+#!/bin/sh
+# ep50 expert extension (round 4, final compute phase): the v3 evals showed
+# the gate works (51.5% top-1, 89% recall@16/50) but coord L1 ~0.3-0.7
+# floors every mode at 0% 5cm/5deg.  Double each expert's budget
+# (600 -> 1200 iters, resumable no-ops for any already there), then re-run
+# the three evals + agreements.  Sequential, pidfile-disciplined; safe to
+# interrupt at any point (the driver's bench SIGSTOPs this group).
+set -e
+cd "$(dirname "$0")/.."
+echo $$ > .pipeline.pid
+trap 'rm -f .pipeline.pid' EXIT INT TERM
+
+SCENES=$(seq -f synth%g 0 49)
+EXPERTS=$(seq -f ckpts/ckpt_ep50_%g 0 49)
+GATING=ckpts/ckpt_ep50_gating_small
+RES="48 64"
+
+resume_flag() {
+  if [ -d "$1/opt_state" ] || [ -d "$1.old/opt_state" ]; then echo "--resume"; fi
+  return 0
+}
+
+echo "=== ep50 experts -> 1200 iters ($(date)) ==="
+i=0
+for s in $SCENES; do
+  ck="ckpts/ckpt_ep50_$i"
+  python train_expert.py "$s" --cpu --size test --frames 96 --res $RES \
+    --iterations 1200 --learningrate 2e-3 --batch 8 \
+    --checkpoint-every 300 $(resume_flag "$ck") --output "$ck" | tail -1
+  i=$((i+1))
+done
+
+echo "=== ep50v4 eval: sharded routed, capacity 2 ($(date)) ==="
+python test_esac.py $SCENES --cpu --size test --frames 4 --res $RES \
+  --experts $EXPERTS --gating "$GATING" --hypotheses 64 \
+  --sharded --capacity 2 --devices 8 --json .ep50_routed.json | tail -8
+
+echo "=== ep50v4 eval: sharded dense ($(date)) ==="
+python test_esac.py $SCENES --cpu --size test --frames 4 --res $RES \
+  --experts $EXPERTS --gating "$GATING" --hypotheses 64 \
+  --sharded --devices 8 --json .ep50_dense.json | tail -8
+
+echo "=== ep50v4 eval: single-chip topk 16 ($(date)) ==="
+python test_esac.py $SCENES --cpu --size test --frames 4 --res $RES \
+  --experts $EXPERTS --gating "$GATING" --hypotheses 64 \
+  --topk 16 --json .ep50_topk.json | tail -8
+
+echo "=== ep50v4 agreement ($(date)) ==="
+python tools/eval_agreement.py .ep50_routed.json .ep50_dense.json \
+  -o .ep50_agreement.json
+python tools/eval_agreement.py .ep50_routed.json .ep50_topk.json \
+  -o .ep50_agreement_topk.json
+
+echo "=== ep50v4 done ($(date)) ==="
