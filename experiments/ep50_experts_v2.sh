@@ -26,24 +26,24 @@ for s in $SCENES; do
   ck="ckpts/ckpt_ep50_$i"
   python train_expert.py "$s" --cpu --size test --frames 96 --res $RES \
     --iterations 1200 --learningrate 2e-3 --batch 8 \
-    --checkpoint-every 300 $(resume_flag "$ck") --output "$ck" | tail -1
+    --checkpoint-every 300 $(resume_flag "$ck") --output "$ck"
   i=$((i+1))
 done
 
 echo "=== ep50v4 eval: sharded routed, capacity 2 ($(date)) ==="
 python test_esac.py $SCENES --cpu --size test --frames 4 --res $RES \
   --experts $EXPERTS --gating "$GATING" --hypotheses 64 \
-  --sharded --capacity 2 --devices 8 --json .ep50_routed.json | tail -8
+  --sharded --capacity 2 --devices 8 --json .ep50_routed.json
 
 echo "=== ep50v4 eval: sharded dense ($(date)) ==="
 python test_esac.py $SCENES --cpu --size test --frames 4 --res $RES \
   --experts $EXPERTS --gating "$GATING" --hypotheses 64 \
-  --sharded --devices 8 --json .ep50_dense.json | tail -8
+  --sharded --devices 8 --json .ep50_dense.json
 
 echo "=== ep50v4 eval: single-chip topk 16 ($(date)) ==="
 python test_esac.py $SCENES --cpu --size test --frames 4 --res $RES \
   --experts $EXPERTS --gating "$GATING" --hypotheses 64 \
-  --topk 16 --json .ep50_topk.json | tail -8
+  --topk 16 --json .ep50_topk.json
 
 echo "=== ep50v4 agreement ($(date)) ==="
 python tools/eval_agreement.py .ep50_routed.json .ep50_dense.json \

@@ -27,22 +27,22 @@ echo "=== ep50v2 gating (ref size) over 50 scenes ($(date)) ==="
 python train_gating.py $SCENES --cpu --size ref --frames 48 --res $RES \
   --iterations 6000 --learningrate 1e-3 --batch 16 \
   --checkpoint-every 1000 $(resume_flag "$GATING") \
-  --output "$GATING" | tail -3
+  --output "$GATING"
 
 echo "=== ep50v2 eval: sharded routed, capacity 2 ($(date)) ==="
 python test_esac.py $SCENES --cpu --size test --frames 4 --res $RES \
   --experts $EXPERTS --gating "$GATING" --hypotheses 64 \
-  --sharded --capacity 2 --devices 8 --json .ep50_routed.json | tail -6
+  --sharded --capacity 2 --devices 8 --json .ep50_routed.json
 
 echo "=== ep50v2 eval: sharded dense ($(date)) ==="
 python test_esac.py $SCENES --cpu --size test --frames 4 --res $RES \
   --experts $EXPERTS --gating "$GATING" --hypotheses 64 \
-  --sharded --devices 8 --json .ep50_dense.json | tail -6
+  --sharded --devices 8 --json .ep50_dense.json
 
 echo "=== ep50v2 eval: single-chip topk 16 ($(date)) ==="
 python test_esac.py $SCENES --cpu --size test --frames 4 --res $RES \
   --experts $EXPERTS --gating "$GATING" --hypotheses 64 \
-  --topk 16 --json .ep50_topk.json | tail -6
+  --topk 16 --json .ep50_topk.json
 
 echo "=== ep50v2 agreement: routed vs dense, routed vs topk ($(date)) ==="
 python tools/eval_agreement.py .ep50_routed.json .ep50_dense.json \

@@ -48,7 +48,7 @@ for s in $SCENES; do
   ck="ckpts/ckpt_ep50_$i"
   python train_expert.py "$s" --cpu --size test --frames 96 --res $RES \
     --iterations 600 --learningrate 2e-3 --batch 8 \
-    --checkpoint-every 200 $(resume_flag "$ck") --output "$ck" | tail -1
+    --checkpoint-every 200 $(resume_flag "$ck") --output "$ck"
   i=$((i+1))
 done
 
@@ -56,22 +56,22 @@ echo "=== ep50 stage 2: gating over $N scenes ($(date)) ==="
 python train_gating.py $SCENES --cpu --size test --frames 48 --res $RES \
   --iterations 6000 --learningrate 1e-3 --batch 8 \
   --checkpoint-every 1000 $(resume_flag "$GATING") \
-  --output "$GATING" | tail -2
+  --output "$GATING"
 
 echo "=== ep50 eval: sharded routed, capacity 2 ($(date)) ==="
 python test_esac.py $SCENES --cpu --size test --frames 4 --res $RES \
   --experts $EXPERTS --gating "$GATING" --hypotheses 64 \
-  --sharded --capacity 2 --devices 8 --json .ep50_routed.json | tail -6
+  --sharded --capacity 2 --devices 8 --json .ep50_routed.json
 
 echo "=== ep50 eval: sharded dense ($(date)) ==="
 python test_esac.py $SCENES --cpu --size test --frames 4 --res $RES \
   --experts $EXPERTS --gating "$GATING" --hypotheses 64 \
-  --sharded --devices 8 --json .ep50_dense.json | tail -6
+  --sharded --devices 8 --json .ep50_dense.json
 
 echo "=== ep50 eval: single-chip topk 16 ($(date)) ==="
 python test_esac.py $SCENES --cpu --size test --frames 4 --res $RES \
   --experts $EXPERTS --gating "$GATING" --hypotheses 64 \
-  --topk 16 --json .ep50_topk.json | tail -6
+  --topk 16 --json .ep50_topk.json
 
 echo "=== ep50 agreement: routed vs dense ($(date)) ==="
 python tools/eval_agreement.py .ep50_routed.json .ep50_dense.json \

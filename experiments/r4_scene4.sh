@@ -39,4 +39,7 @@ python test_esac.py $SCENES --cpu --size ref --frames 48 --res $RES \
   --experts $EXPERTS --gating ckpt_r4_gating4 --hypotheses 256 --backend cpp \
   --json .r4_eval_4scene_cpp.json
 
+echo "=== r4 assemble ($(date)) ==="
+python tools/assemble_r3_eval.py
+
 echo "=== r4 4-scene done ($(date)) ==="

@@ -82,3 +82,33 @@ def test_assemble_r3_eval_scans_both_logs(tmp_path, monkeypatch):
     assert out["stage2_gating_final_ce"] == 0.1
     assert out["complete"] is False  # synth2 + cpp eval missing
     assert out["missing_experts"] == ["synth2"]
+
+
+def test_assemble_r3_eval_4scene_extension(tmp_path, monkeypatch):
+    import assemble_r3_eval as asm
+
+    monkeypatch.setattr(asm, "ROOT", tmp_path)
+    monkeypatch.setattr(asm, "LOGS", [tmp_path / "a.log"])
+    (tmp_path / "a.log").write_text(
+        "saved ckpt_r3_expert_synth0  final coord L1 0.05\n"
+        "saved ckpt_r3_expert_synth1  final coord L1 0.04\n"
+        "saved ckpt_r3_expert_synth2  final coord L1 0.04\n"
+        "saved ckpt_r3_gating  final CE 0.0\n"
+        "saved ckpt_r3_expert_synth3  final coord L1 0.06\n"
+        "saved ckpt_r4_gating4  final CE 0.1\n"
+    )
+    for b in ("jax", "cpp"):
+        (tmp_path / f".r3_eval_stage2_{b}.json").write_text(
+            json.dumps({"pct_5cm5deg": 21.5})
+        )
+        (tmp_path / f".r4_eval_4scene_{b}.json").write_text(
+            json.dumps({"pct_5cm5deg": 20.0})
+        )
+    asm.main()
+    out = json.loads((tmp_path / "R3_SCALE_EVAL.json").read_text())
+    assert out["complete"] is True
+    ext = out["extension_4scene"]
+    assert ext["complete"] is True
+    assert ext["stage1_final_coord_l1_synth3"] == 0.06
+    assert ext["stage2_gating_final_ce"] == 0.1
+    assert ext["eval"]["cpp"]["pct_5cm5deg"] == 20.0

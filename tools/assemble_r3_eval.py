@@ -21,14 +21,16 @@ import sys
 from pathlib import Path
 
 ROOT = Path(__file__).resolve().parent.parent
-LOGS = [ROOT / ".r3_pipeline.log", ROOT / ".r4_queue.log"]
+LOGS = [ROOT / ".r3_pipeline.log", ROOT / ".r4_queue.log",
+        ROOT / ".r4_scene4.log"]
 SCENES = ["synth0", "synth1", "synth2"]
+SCENE4 = "synth3"
 
 
 def scan_logs():
     """Last 'saved <ckpt> final <unit> <loss>' per checkpoint across logs."""
     finals: dict[str, float] = {}
-    pat = re.compile(r"saved (ckpt_r3_\w+)\s+final (?:coord L1|CE) ([0-9.]+)")
+    pat = re.compile(r"saved (ckpt_r[34]_\w+)\s+final (?:coord L1|CE) ([0-9.]+)")
     for log in LOGS:
         if not log.exists():
             continue
@@ -65,6 +67,25 @@ def main() -> int:
     }
     if missing:
         out["missing_experts"] = missing
+
+    # 4-scene extension (experiments/r4_scene4.sh, spare end-of-round core
+    # time): the originally-planned scene count, reported alongside — the
+    # 3-scene block above stays the committed acceptance table.
+    ev4 = {}
+    for backend in ("jax", "cpp"):
+        p = ROOT / f".r4_eval_4scene_{backend}.json"
+        if p.exists():
+            ev4[backend] = json.loads(p.read_text())
+    if ev4 or f"ckpt_r3_expert_{SCENE4}" in finals:
+        out["extension_4scene"] = {
+            "scenes": SCENES + [SCENE4],
+            "stage1_final_coord_l1_synth3":
+                finals.get(f"ckpt_r3_expert_{SCENE4}"),
+            "stage2_gating_final_ce": finals.get("ckpt_r4_gating4"),
+            "eval": ev4,
+            "complete": (f"ckpt_r3_expert_{SCENE4}" in finals
+                         and "jax" in ev4 and "cpp" in ev4),
+        }
     path = ROOT / "R3_SCALE_EVAL.json"
     path.write_text(json.dumps(out, indent=2) + "\n")
     print(f"wrote {path} (complete={out['complete']})")
