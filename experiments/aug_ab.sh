@@ -1,0 +1,24 @@
+#!/bin/sh
+# Micro-experiment: does --augment lift test-size experts off the
+# novel-view generalization floor? 3 scenes, same budget as ep50 v4
+# (1200 iters, 96 frames, 48x64), 3-way gating, eval vs the
+# non-augmented ckpts/ckpt_ep50_{0,1,2}.
+set -e
+cd /root/repo
+echo $$ > .pipeline.pid
+trap 'rm -f .pipeline.pid' EXIT INT TERM
+for i in 0 1 2; do
+  python train_expert.py synth$i --cpu --size test --frames 96 --res 48 64 \
+    --iterations 1200 --learningrate 2e-3 --batch 8 --augment \
+    --checkpoint-every 400 --output ckpts/ckpt_aug_$i
+done
+python train_gating.py synth0 synth1 synth2 --cpu --size test --frames 48 \
+  --res 48 64 --iterations 2000 --learningrate 1e-3 --batch 8 \
+  --checkpoint-every 0 --output ckpts/ckpt_aug_gating
+python test_esac.py synth0 synth1 synth2 --cpu --size test --frames 16 \
+  --res 48 64 --experts ckpts/ckpt_aug_0 ckpts/ckpt_aug_1 ckpts/ckpt_aug_2 \
+  --gating ckpts/ckpt_aug_gating --hypotheses 64 --json .aug_ab_augmented.json
+python test_esac.py synth0 synth1 synth2 --cpu --size test --frames 16 \
+  --res 48 64 --experts ckpts/ckpt_ep50_0 ckpts/ckpt_ep50_1 ckpts/ckpt_ep50_2 \
+  --gating ckpts/ckpt_aug_gating --hypotheses 64 --json .aug_ab_plain.json
+echo "=== aug A/B done ==="
