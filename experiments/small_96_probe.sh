@@ -1,0 +1,22 @@
+#!/bin/sh
+# Capacity-floor validation (EP50_DEMO.md item 4's prediction): the "small"
+# expert preset (~2M params) at 96x128 should clear the 5cm/5deg floor the
+# test-size nets at 48x64 cannot, at a fraction of ref cost. 2 scenes,
+# 1000 iters each — a probe, not a table.
+set -e
+cd "$(dirname "$0")/.."
+echo $$ > .pipeline.pid
+trap 'rm -f .pipeline.pid' EXIT INT TERM
+for i in 0 1; do
+  python train_expert.py synth$i --cpu --size small --frames 256 \
+    --res 96 128 --iterations 1000 --learningrate 1e-3 --batch 8 \
+    --checkpoint-every 250 --output ckpts/ckpt_small96_$i
+done
+python train_gating.py synth0 synth1 --cpu --size small --frames 64 \
+  --res 96 128 --iterations 600 --learningrate 1e-3 --batch 8 \
+  --checkpoint-every 0 --output ckpts/ckpt_small96_gating
+python test_esac.py synth0 synth1 --cpu --size small --frames 16 \
+  --res 96 128 --experts ckpts/ckpt_small96_0 ckpts/ckpt_small96_1 \
+  --gating ckpts/ckpt_small96_gating --hypotheses 256 \
+  --json .small96_probe.json
+echo "=== small96 probe done ==="
