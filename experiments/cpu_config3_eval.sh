@@ -13,7 +13,7 @@ cd "$(dirname "$0")/.."
 
 SCENES="synth0 synth1 synth2 synth3 synth4 synth5 synth6 synth7"
 EXPERTS=""
-for s in $SCENES; do EXPERTS="$EXPERTS ckpt_cpu_expert_$s"; done
+for s in $SCENES; do EXPERTS="$EXPERTS ckpts/ckpt_cpu_expert_$s"; done
 
 resume_flag() {
   if [ -d "$1/opt_state" ] || [ -d "$1.old/opt_state" ]; then echo "--resume"; fi
@@ -22,7 +22,7 @@ resume_flag() {
 
 echo "=== config3 stage 1: 8 experts ($(date)) ==="
 for s in $SCENES; do
-  ck="ckpt_cpu_expert_$s"
+  ck="ckpts/ckpt_cpu_expert_$s"
   echo "--- expert $s ---"
   python train_expert.py "$s" --cpu --size test --frames 768 \
     --iterations 4000 --learningrate 1e-3 --batch 8 \
@@ -32,21 +32,21 @@ done
 echo "=== config3 stage 2: gating over 8 ($(date)) ==="
 python train_gating.py $SCENES --cpu --size test --frames 256 \
   --iterations 2000 --learningrate 1e-3 --batch 8 \
-  --checkpoint-every 500 $(resume_flag ckpt_cpu_gating8) --output ckpt_cpu_gating8
+  --checkpoint-every 500 $(resume_flag ckpts/ckpt_cpu_gating8) --output ckpts/ckpt_cpu_gating8
 
 echo "=== config3 eval: dense (all 8 experts) ($(date)) ==="
 python test_esac.py $SCENES --cpu --size test --frames 8 \
-  --experts $EXPERTS --gating ckpt_cpu_gating8 --hypotheses 64 \
+  --experts $EXPERTS --gating ckpts/ckpt_cpu_gating8 --hypotheses 64 \
   --json .cpu_eval_config3_dense.json
 
 echo "=== config3 eval: --topk 2 (gating-pruned) ($(date)) ==="
 python test_esac.py $SCENES --cpu --size test --frames 8 \
-  --experts $EXPERTS --gating ckpt_cpu_gating8 --hypotheses 64 --topk 2 \
+  --experts $EXPERTS --gating ckpts/ckpt_cpu_gating8 --hypotheses 64 --topk 2 \
   --json .cpu_eval_config3_topk2.json
 
 echo "=== config3 eval: cpp gating-drawn loop ($(date)) ==="
 python test_esac.py $SCENES --cpu --size test --frames 8 \
-  --experts $EXPERTS --gating ckpt_cpu_gating8 --hypotheses 64 --backend cpp \
+  --experts $EXPERTS --gating ckpts/ckpt_cpu_gating8 --hypotheses 64 --backend cpp \
   --json .cpu_eval_config3_cpp.json
 
 echo "=== config3 done ($(date)) ==="

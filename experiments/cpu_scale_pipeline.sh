@@ -11,7 +11,7 @@ set -e
 cd "$(dirname "$0")/.."
 
 SCENES="synth0 synth1 synth2"
-EXPERTS="ckpt_cpu_expert_synth0 ckpt_cpu_expert_synth1 ckpt_cpu_expert_synth2"
+EXPERTS="ckpts/ckpt_cpu_expert_synth0 ckpts/ckpt_cpu_expert_synth1 ckpts/ckpt_cpu_expert_synth2"
 
 # Same contract as ref_scale_pipeline.sh: stage-1/2 trainers keep opt_state
 # inside the output dir; stage 3 uses the separate <output>_state dir (pass
@@ -23,7 +23,7 @@ resume_flag() {
 
 echo "=== cpu stage 1: experts ($(date)) ==="
 for s in $SCENES; do
-  ck="ckpt_cpu_expert_$s"
+  ck="ckpts/ckpt_cpu_expert_$s"
   echo "--- expert $s ---"
   python train_expert.py "$s" --cpu --size test --frames 768 \
     --iterations 4000 --learningrate 1e-3 --batch 8 \
@@ -33,29 +33,29 @@ done
 echo "=== cpu stage 2: gating ($(date)) ==="
 python train_gating.py $SCENES --cpu --size test --frames 256 \
   --iterations 1200 --learningrate 1e-3 --batch 8 \
-  --checkpoint-every 400 $(resume_flag ckpt_cpu_gating) --output ckpt_cpu_gating
+  --checkpoint-every 400 $(resume_flag ckpts/ckpt_cpu_gating) --output ckpts/ckpt_cpu_gating
 
 echo "=== cpu eval stage 2, jax ($(date)) ==="
 python test_esac.py $SCENES --cpu --size test --frames 16 \
-  --experts $EXPERTS --gating ckpt_cpu_gating --hypotheses 64 \
+  --experts $EXPERTS --gating ckpts/ckpt_cpu_gating --hypotheses 64 \
   --json .cpu_eval_stage2_jax.json
 
 echo "=== cpu stage 3: end-to-end ($(date)) ==="
 # lr 1e-6: 1e-5 regresses strong stage-1 baselines (CPU_SCALE_EVAL.json).
 python train_esac.py $SCENES --cpu --size test --frames 128 \
   --iterations 150 --learningrate 1e-6 --batch 2 --hypotheses 16 \
-  --checkpoint-every 50 $(resume_flag ckpt_cpu_esac_state) \
-  --experts $EXPERTS --gating ckpt_cpu_gating --output ckpt_cpu_esac
+  --checkpoint-every 50 $(resume_flag ckpts/ckpt_cpu_esac_state) \
+  --experts $EXPERTS --gating ckpts/ckpt_cpu_gating --output ckpts/ckpt_cpu_esac
 
-E3="ckpt_cpu_esac_expert0 ckpt_cpu_esac_expert1 ckpt_cpu_esac_expert2"
+E3="ckpts/ckpt_cpu_esac_expert0 ckpts/ckpt_cpu_esac_expert1 ckpts/ckpt_cpu_esac_expert2"
 echo "=== cpu eval stage 3, jax ($(date)) ==="
 python test_esac.py $SCENES --cpu --size test --frames 16 \
-  --experts $E3 --gating ckpt_cpu_esac_gating --hypotheses 64 \
+  --experts $E3 --gating ckpts/ckpt_cpu_esac_gating --hypotheses 64 \
   --json .cpu_eval_stage3_jax.json
 
 echo "=== cpu eval stage 3, cpp ($(date)) ==="
 python test_esac.py $SCENES --cpu --size test --frames 16 \
-  --experts $E3 --gating ckpt_cpu_esac_gating --hypotheses 64 --backend cpp \
+  --experts $E3 --gating ckpts/ckpt_cpu_esac_gating --hypotheses 64 --backend cpp \
   --json .cpu_eval_stage3_cpp.json
 
 echo "=== cpu pipeline done ($(date)) ==="

@@ -15,7 +15,7 @@ set -e
 cd "$(dirname "$0")/.."
 
 SCENES="synth0 synth1 synth2 synth3"
-EXPERTS="ckpt_r3_expert_synth0 ckpt_r3_expert_synth1 ckpt_r3_expert_synth2 ckpt_r3_expert_synth3"
+EXPERTS="ckpts/ckpt_r3_expert_synth0 ckpts/ckpt_r3_expert_synth1 ckpts/ckpt_r3_expert_synth2 ckpts/ckpt_r3_expert_synth3"
 RES="96 128"
 
 resume_flag() {
@@ -25,7 +25,7 @@ resume_flag() {
 
 echo "=== r3 stage 1: experts ($(date)) ==="
 for s in $SCENES; do
-  ck="ckpt_r3_expert_$s"
+  ck="ckpts/ckpt_r3_expert_$s"
   echo "--- expert $s ---"
   python train_expert.py "$s" --cpu --size ref --frames 1024 --res $RES \
     --iterations 2500 --learningrate 1e-3 --batch 8 \
@@ -35,16 +35,16 @@ done
 echo "=== r3 stage 2: gating ($(date)) ==="
 python train_gating.py $SCENES --cpu --size ref --frames 512 --res $RES \
   --iterations 1500 --learningrate 1e-3 --batch 8 \
-  --checkpoint-every 250 $(resume_flag ckpt_r3_gating) --output ckpt_r3_gating
+  --checkpoint-every 250 $(resume_flag ckpts/ckpt_r3_gating) --output ckpts/ckpt_r3_gating
 
 echo "=== r3 eval stage 2, jax ($(date)) ==="
 python test_esac.py $SCENES --cpu --size ref --frames 48 --res $RES \
-  --experts $EXPERTS --gating ckpt_r3_gating --hypotheses 256 \
+  --experts $EXPERTS --gating ckpts/ckpt_r3_gating --hypotheses 256 \
   --json .r3_eval_stage2_jax.json
 
 echo "=== r3 eval stage 2, cpp ($(date)) ==="
 python test_esac.py $SCENES --cpu --size ref --frames 48 --res $RES \
-  --experts $EXPERTS --gating ckpt_r3_gating --hypotheses 256 --backend cpp \
+  --experts $EXPERTS --gating ckpts/ckpt_r3_gating --hypotheses 256 --backend cpp \
   --json .r3_eval_stage2_cpp.json
 
 echo "=== r3 stages 1+2 done ($(date)) ==="

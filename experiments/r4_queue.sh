@@ -28,7 +28,7 @@ done
 
 # ---- config #2 at ref-size nets: stage 1 + 2 + dual-backend eval ----------
 SCENES="synth0 synth1 synth2"
-EXPERTS="ckpt_r3_expert_synth0 ckpt_r3_expert_synth1 ckpt_r3_expert_synth2"
+EXPERTS="ckpts/ckpt_r3_expert_synth0 ckpts/ckpt_r3_expert_synth1 ckpts/ckpt_r3_expert_synth2"
 RES="96 128"
 
 resume_flag() {
@@ -40,7 +40,7 @@ r3_table() (
   set -e
   log "r3 stage 1: experts"
   for s in $SCENES; do
-    ck="ckpt_r3_expert_$s"
+    ck="ckpts/ckpt_r3_expert_$s"
     log "expert $s"
     python train_expert.py "$s" --cpu --size ref --frames 1024 --res $RES \
       --iterations 2500 --learningrate 1e-3 --batch 8 \
@@ -50,16 +50,16 @@ r3_table() (
   log "r3 stage 2: gating"
   python train_gating.py $SCENES --cpu --size ref --frames 512 --res $RES \
     --iterations 1500 --learningrate 1e-3 --batch 8 \
-    --checkpoint-every 250 $(resume_flag ckpt_r3_gating) --output ckpt_r3_gating
+    --checkpoint-every 250 $(resume_flag ckpts/ckpt_r3_gating) --output ckpts/ckpt_r3_gating
 
   log "r3 eval stage 2, jax"
   python test_esac.py $SCENES --cpu --size ref --frames 48 --res $RES \
-    --experts $EXPERTS --gating ckpt_r3_gating --hypotheses 256 \
+    --experts $EXPERTS --gating ckpts/ckpt_r3_gating --hypotheses 256 \
     --json .r3_eval_stage2_jax.json
 
   log "r3 eval stage 2, cpp"
   python test_esac.py $SCENES --cpu --size ref --frames 48 --res $RES \
-    --experts $EXPERTS --gating ckpt_r3_gating --hypotheses 256 --backend cpp \
+    --experts $EXPERTS --gating ckpts/ckpt_r3_gating --hypotheses 256 --backend cpp \
     --json .r3_eval_stage2_cpp.json
 
   log "r3 assemble R3_SCALE_EVAL.json"

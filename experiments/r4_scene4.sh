@@ -10,7 +10,7 @@ echo $$ > .pipeline.pid
 trap 'rm -f .pipeline.pid' EXIT INT TERM
 
 SCENES="synth0 synth1 synth2 synth3"
-EXPERTS="ckpt_r3_expert_synth0 ckpt_r3_expert_synth1 ckpt_r3_expert_synth2 ckpt_r3_expert_synth3"
+EXPERTS="ckpts/ckpt_r3_expert_synth0 ckpts/ckpt_r3_expert_synth1 ckpts/ckpt_r3_expert_synth2 ckpts/ckpt_r3_expert_synth3"
 RES="96 128"
 
 resume_flag() {
@@ -21,22 +21,22 @@ resume_flag() {
 echo "=== r4 expert synth3 ($(date)) ==="
 python train_expert.py synth3 --cpu --size ref --frames 1024 --res $RES \
   --iterations 2500 --learningrate 1e-3 --batch 8 \
-  --checkpoint-every 250 $(resume_flag ckpt_r3_expert_synth3) \
-  --output ckpt_r3_expert_synth3
+  --checkpoint-every 250 $(resume_flag ckpts/ckpt_r3_expert_synth3) \
+  --output ckpts/ckpt_r3_expert_synth3
 
 echo "=== r4 gating over 4 scenes ($(date)) ==="
 python train_gating.py $SCENES --cpu --size ref --frames 512 --res $RES \
   --iterations 1500 --learningrate 1e-3 --batch 8 \
-  --checkpoint-every 250 $(resume_flag ckpt_r4_gating4) --output ckpt_r4_gating4
+  --checkpoint-every 250 $(resume_flag ckpts/ckpt_r4_gating4) --output ckpts/ckpt_r4_gating4
 
 echo "=== r4 eval 4-scene, jax ($(date)) ==="
 python test_esac.py $SCENES --cpu --size ref --frames 48 --res $RES \
-  --experts $EXPERTS --gating ckpt_r4_gating4 --hypotheses 256 \
+  --experts $EXPERTS --gating ckpts/ckpt_r4_gating4 --hypotheses 256 \
   --json .r4_eval_4scene_jax.json
 
 echo "=== r4 eval 4-scene, cpp ($(date)) ==="
 python test_esac.py $SCENES --cpu --size ref --frames 48 --res $RES \
-  --experts $EXPERTS --gating ckpt_r4_gating4 --hypotheses 256 --backend cpp \
+  --experts $EXPERTS --gating ckpts/ckpt_r4_gating4 --hypotheses 256 --backend cpp \
   --json .r4_eval_4scene_cpp.json
 
 echo "=== r4 assemble ($(date)) ==="

@@ -2,7 +2,7 @@
 # Stage-3 recipe sweep (VERDICT r2 #5): can end-to-end training IMPROVE a
 # strong stage-1 baseline?  Round-2 evidence: lr 1e-5 regresses 27%->10%,
 # lr 1e-6 only preserves.  Hypotheses tested here, all from the SAME strong
-# baseline (ckpt_cpu_expert_synth*, 27.08% stage-2 eval, CPU_SCALE_EVAL):
+# baseline (ckpts/ckpt_cpu_expert_synth*, 27.08% stage-2 eval, CPU_SCALE_EVAL):
 #
 #   clip   — the IRLS-refinement gradient spikes on near-degenerate
 #            hypotheses; global-norm clipping tames the noise that made
@@ -20,8 +20,8 @@ set -e
 cd "$(dirname "$0")/.."
 
 SCENES="synth0 synth1 synth2"
-BASE_E="ckpt_cpu_expert_synth0 ckpt_cpu_expert_synth1 ckpt_cpu_expert_synth2"
-BASE_G="ckpt_cpu_gating"
+BASE_E="ckpts/ckpt_cpu_expert_synth0 ckpts/ckpt_cpu_expert_synth1 ckpts/ckpt_cpu_expert_synth2"
+BASE_G="ckpts/ckpt_cpu_gating"
 
 run_leg() {
   name=$1; shift
@@ -29,10 +29,10 @@ run_leg() {
   python train_esac.py $SCENES --cpu --size test --frames 128 \
     --experts $BASE_E --gating $BASE_G \
     --iterations 150 --checkpoint-every 0 \
-    --output "ckpt_s3_$name" "$@"
-  E3="ckpt_s3_${name}_expert0 ckpt_s3_${name}_expert1 ckpt_s3_${name}_expert2"
+    --output "ckpts/ckpt_s3_$name" "$@"
+  E3="ckpts/ckpt_s3_${name}_expert0 ckpts/ckpt_s3_${name}_expert1 ckpts/ckpt_s3_${name}_expert2"
   python test_esac.py $SCENES --cpu --size test --frames 16 \
-    --experts $E3 --gating "ckpt_s3_${name}_gating" --hypotheses 64 \
+    --experts $E3 --gating "ckpts/ckpt_s3_${name}_gating" --hypotheses 64 \
     --json ".s3_${name}.json" | tail -5
 }
 

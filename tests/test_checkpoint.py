@@ -100,7 +100,7 @@ def test_restore_tpu_written_checkpoint_on_cpu():
 
     from esac_tpu.utils.checkpoint import load_checkpoint
 
-    ck = pathlib.Path(__file__).parent.parent / "ckpt_expert_synth0"
+    ck = pathlib.Path(__file__).parent.parent / "ckpts" / "ckpt_expert_synth0"
     params, cfg = load_checkpoint(ck)
     assert cfg["scene"] == "synth0"
     import jax

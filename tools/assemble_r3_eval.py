@@ -30,7 +30,10 @@ SCENE4 = "synth3"
 def scan_logs():
     """Last 'saved <ckpt> final <unit> <loss>' per checkpoint across logs."""
     finals: dict[str, float] = {}
-    pat = re.compile(r"saved (ckpt_r[34]_\w+)\s+final (?:coord L1|CE) ([0-9.]+)")
+    # ckpts/ prefix optional so pre- and post-rename logs both parse.
+    pat = re.compile(
+        r"saved (?:ckpts/)?(ckpt_r[34]_\w+)\s+final (?:coord L1|CE) ([0-9.]+)"
+    )
     for log in LOGS:
         if not log.exists():
             continue
