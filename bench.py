@@ -423,7 +423,54 @@ def _pause_pipelines() -> tuple[list[int], list[float]]:
             stopped.append(pg)
         except Exception:
             pass
+    # Breadcrumb for unclean death (ADVICE r4): if bench is SIGKILLed/OOMed
+    # between here and the finally-block SIGCONT, the stopped queues would
+    # stay frozen forever on this 1-core box.  The next bench invocation
+    # resumes anything listed here (_resume_stale_breadcrumb) before
+    # pausing its own set; clean exits remove the file.
+    if stopped:
+        try:
+            (_REPO / ".bench_paused.pgids").write_text(
+                f"owner={os.getpid()} "
+                + " ".join(str(pg) for pg in stopped) + "\n")
+        except Exception:
+            pass
     return stopped, load_before
+
+
+def _resume_stale_breadcrumb() -> None:
+    """SIGCONT process groups a previously-killed bench left SIGSTOPped
+    (recorded in .bench_paused.pgids; see _pause_pipelines).
+
+    The breadcrumb names its writing bench (owner=<pid>): if that bench is
+    still alive, its pause is LIVE — resuming would un-quiet a measurement
+    in progress on this 1-core box — so leave it alone and let the owner's
+    finally-block clean up."""
+    crumb = _REPO / ".bench_paused.pgids"
+    try:
+        toks = crumb.read_text().split()
+    except Exception:
+        return
+    pgids = []
+    for tok in toks:
+        try:
+            if tok.startswith("owner="):
+                owner = int(tok[len("owner="):])
+                if _pid_running(owner) and owner != os.getpid():
+                    return  # live bench owns this pause
+            else:
+                pgids.append(int(tok))
+        except ValueError:
+            continue  # malformed token: still resume what parses
+    for pg in pgids:
+        try:
+            os.killpg(pg, signal.SIGCONT)
+        except Exception:
+            pass
+    try:
+        crumb.unlink()
+    except Exception:
+        pass
 
 
 def _pgid_cpu_only(pgid: int) -> bool:
@@ -476,6 +523,10 @@ def _resume_pipelines(stopped: list[int]) -> None:
             os.killpg(pg, signal.SIGCONT)
         except Exception:
             pass
+    try:
+        (_REPO / ".bench_paused.pgids").unlink()
+    except Exception:
+        pass
 
 
 def _contention_block(stopped: list[int], load_before: list[float]) -> dict:
@@ -497,6 +548,7 @@ def main() -> None:
     if len(sys.argv) > 2 and sys.argv[1] == "--device-child":
         device_child(json.loads(sys.argv[2]))
         return
+    _resume_stale_breadcrumb()
     stopped, load_before = _pause_pipelines()
     try:
         _main_measured(stopped, load_before)

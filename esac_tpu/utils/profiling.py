@@ -256,6 +256,11 @@ def xla_score_flops_per_cell(n_cells: int = 1200, n_hyps: int = 64) -> float:
     accounting differs in transcendental weighting, so agreement within ~2x
     validates the order of magnitude (pinned in tests/test_profiling.py).
     """
+    # Force the CPU backend before any jit/lower: this helper is attractive
+    # to call from an ad-hoc interpreter, and per CLAUDE.md a bare backend
+    # init while the TPU relay is unhealthy hangs forever (ADVICE r4).
+    jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
 
     from esac_tpu.ransac.config import RansacConfig

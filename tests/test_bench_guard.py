@@ -310,3 +310,37 @@ def test_pause_pipelines_skips_group_with_non_cpu_python(tmp_path, monkeypatch):
     finally:
         child.kill()
         child.wait()
+
+
+def test_breadcrumb_dead_owner_resumed_and_cleaned(tmp_path, monkeypatch):
+    """ADVICE r4: a bench SIGKILLed mid-pause must not freeze the queues
+    forever — the next invocation resumes pgids from the breadcrumb."""
+    import os
+    import signal as sig
+
+    monkeypatch.setattr(bench, "_REPO", tmp_path)
+    sent = []
+    monkeypatch.setattr(bench.os, "killpg",
+                        lambda pg, s: sent.append((pg, s)))
+    # Owner pid 999999 is dead -> resume listed pgids, remove the file.
+    crumb = tmp_path / ".bench_paused.pgids"
+    crumb.write_text("owner=999999 12345 67890\n")
+    bench._resume_stale_breadcrumb()
+    assert sent == [(12345, sig.SIGCONT), (67890, sig.SIGCONT)]
+    assert not crumb.exists()
+
+
+def test_breadcrumb_live_owner_left_alone(tmp_path, monkeypatch):
+    """A breadcrumb owned by a still-running bench is a LIVE pause: resuming
+    would un-quiet a measurement in progress (r5 review finding)."""
+    import os
+
+    monkeypatch.setattr(bench, "_REPO", tmp_path)
+    sent = []
+    monkeypatch.setattr(bench.os, "killpg",
+                        lambda pg, s: sent.append((pg, s)))
+    crumb = tmp_path / ".bench_paused.pgids"
+    # A DIFFERENT live pid owns the pause (PID 1 always exists).
+    crumb.write_text("owner=1 12345\n")
+    bench._resume_stale_breadcrumb()
+    assert sent == [] and crumb.exists()

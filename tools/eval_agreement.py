@@ -36,10 +36,36 @@ def agreement(a: dict, b: dict) -> dict:
     hit = lambda art, i: (art["per_frame"]["rot_err_deg"][i] < 5.0  # noqa: E731
                           and art["per_frame"]["trans_err_cm"][i] < 5.0)
     pose_same = sum(hit(a, i) == hit(b, i) for i in range(n))
+    # Near-tie evidence (VERDICT r4 weak #3): when the two regimes pick
+    # different winners, is the consensus argmax a coin flip?  Compare the
+    # winner's score margin over the runner-up expert at disagreement
+    # frames vs agreement frames, from whichever artifact records margins
+    # (dense/topk modes; sharded and cpp record null — see test_esac.py).
+    margin_stats = None
+    for art in (b, a):
+        margins = art.get("per_frame", {}).get("winner_margin")
+        if margins and any(m is not None for m in margins):
+            med = lambda xs: (sorted(xs)[len(xs) // 2] if xs else None)  # noqa: E731
+            dis = [m for m, x, y in zip(margins, ea, eb)
+                   if m is not None and x != y]
+            agr = [m for m, x, y in zip(margins, ea, eb)
+                   if m is not None and x == y]
+            margin_stats = {
+                "from_artifact": art.get("_path"),
+                "median_margin_at_disagreement": med(dis),
+                "median_margin_at_agreement": med(agr),
+                "note": "margin = winning expert's best soft-inlier score "
+                        "minus runner-up expert's best; near-zero at "
+                        "disagreements = the winner flip is a score "
+                        "coin-flip between near-tied experts, not a "
+                        "routing defect",
+            }
+            break
     return {
         "n_frames": n,
         "winner_agreement_pct": round(100.0 * same / n, 2),
         "pose_regime_agreement_pct": round(100.0 * pose_same / n, 2),
+        **({"winner_margin": margin_stats} if margin_stats else {}),
         "a": {"artifact": a.get("_path"), "expert_accuracy_pct":
               a.get("expert_accuracy_pct"), "pct_5cm5deg": a.get("pct_5cm5deg")},
         "b": {"artifact": b.get("_path"), "expert_accuracy_pct":

@@ -79,7 +79,7 @@ def main(argv=None) -> int:
     p.add_argument("--devices", type=int, default=0,
                    help="with --sharded --cpu: number of virtual CPU "
                         "devices for the mesh (0 = all)")
-    p.add_argument("--output", default="ckpt_esac")
+    p.add_argument("--output", default="ckpts/ckpt_esac")
     args = p.parse_args(argv)
     maybe_force_cpu(args)
     if len(args.experts) != len(args.scenes):

@@ -52,6 +52,18 @@ def main(argv=None) -> int:
                         "(default: iterations // 4; 0 disables the bootstrap)")
     p.add_argument("--reproj-clamp", type=float, default=100.0,
                    help="reproj mode: per-cell pixel-error clamp")
+    p.add_argument("--init-from", default=None, metavar="CKPT",
+                   help="initialize params from this checkpoint (fresh "
+                        "optimizer and schedule — a fine-tune, unlike "
+                        "--resume which continues the original run)")
+    p.add_argument("--depth-scale", type=float, default=1.0,
+                   help="coords mode: simulate a miscalibrated depth sensor "
+                        "by scaling the camera-space depth of every "
+                        "supervision target (X' = R^T(s(RX+t)-t)); the "
+                        "stage-3 repair experiment trains stage 1 against "
+                        "s != 1 and lets the pose loss correct it "
+                        "(SURVEY.md §0 stage 3 — the reference's e2e wins "
+                        "come from exactly this kind of sensor error)")
     args = p.parse_args(argv)
     maybe_force_cpu(args)
 
@@ -67,6 +79,15 @@ def main(argv=None) -> int:
 
     probe = batch_frames(ds, np.array([0]))
     params = net.init(jax.random.key(args.seed), probe["images"])
+    if args.init_from:
+        from esac_tpu.utils.checkpoint import load_checkpoint
+
+        init_params, init_cfg = load_checkpoint(args.init_from)
+        if init_cfg.get("size") != args.size:
+            p.error(f"--init-from size {init_cfg.get('size')!r} != --size "
+                    f"{args.size!r}")
+        params = init_params
+        print(f"initialized params from {args.init_from}")
     n_params = sum(p_.size for p_ in jax.tree.leaves(params))
     print(f"scene={args.scene} frames={len(ds)} params={n_params/1e6:.2f}M "
           f"center={np.round(center, 2).tolist()}")
@@ -88,7 +109,7 @@ def main(argv=None) -> int:
         init_iters = (args.init_iters if args.init_iters is not None
                       else args.iterations // 4)
 
-    out = args.output or f"ckpt_expert_{args.scene}"
+    out = args.output or f"ckpts/ckpt_expert_{args.scene}"
     start_it = 0
     if args.resume:
         params, opt_state, _, start_it = load_train_state(out, opt_state)
@@ -102,6 +123,22 @@ def main(argv=None) -> int:
     if mode == "coords":
         coords_d = all_b["coords_gt"]
         masks_d = (jnp.abs(coords_d).sum(-1) > 1e-9).astype(jnp.float32)
+        if args.depth_scale != 1.0:
+            # Corrupted-supervision targets: a sensor reading s*depth
+            # backprojects every camera-space point Y = RX + t to sY, so
+            # the world-space target becomes X' = R^T(sY - t).  Masked
+            # (invalid) cells stay exactly zero so the mask they encode
+            # survives the transform.
+            from esac_tpu.geometry import rodrigues as _rod
+
+            def _corrupt(co, rv, tv):
+                R = _rod(rv)
+                cam = co @ R.T + tv
+                return (args.depth_scale * cam - tv) @ R
+
+            coords_d = jax.jit(jax.vmap(_corrupt))(
+                coords_d, all_b["rvecs"], all_b["tvecs"]
+            ) * masks_d[..., None]
     else:
         rvecs_d, tvecs_d = all_b["rvecs"], all_b["tvecs"]
         focals_d = all_b["focals"]  # (B,): outdoor scenes mix cameras
@@ -200,6 +237,7 @@ def _ck_config(args, center, loss, mode="coords") -> dict:
         "scene_center": [float(x) for x in center],
         "loss_mode": mode,
         "final_loss": float(loss),
+        "depth_scale": args.depth_scale,
     }
 
 

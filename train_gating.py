@@ -28,7 +28,7 @@ from esac_tpu.utils.checkpoint import load_train_state, save_train_state
 def main(argv=None) -> int:
     p = common_parser(__doc__)
     p.add_argument("scenes", nargs="+", help="scene names in expert order")
-    p.add_argument("--output", default="ckpt_gating")
+    p.add_argument("--output", default="ckpts/ckpt_gating")
     args = p.parse_args(argv)
     maybe_force_cpu(args)
 
