@@ -25,6 +25,13 @@ class RansacConfig:
     # selection trains best; 0.05 actively hurts.
     alpha: float = 0.5
     # IRLS (re-weighted Gauss-Newton) rounds when refining the winning pose.
+    # The reference refines to convergence capped ~100 (SURVEY.md §3.5); 8
+    # SATURATES here — measured at ref scale on the committed R3
+    # checkpoints (.refine_sweep_{8,16,32,64}.json: 21.53% 5cm/5deg and
+    # 3.07deg/8.44cm medians identical through 64, the 16+ legs moving
+    # the trans median by 0.001 cm).  Soft-inlier IRLS from the argmax
+    # hypothesis converges in single-digit rounds; extra rounds are pure
+    # cost.
     refine_iters: int = 8
     # Light per-hypothesis refinement rounds inside the training expectation.
     train_refine_iters: int = 2
