@@ -1,0 +1,47 @@
+#!/bin/sh
+# Config-#2 budget-scaling evidence (VERDICT r5 #8): is the committed
+# 21.53%/21.88% (R3_SCALE_EVAL.json) budget-limited — on-trajectory to
+# the single-expert TPU ceiling (100% novel-view at 20k iters/192x256,
+# BENCH_ACCURACY_TPU.json) — or has it plateaued?  One scene's ref-size
+# expert is extended 2500 -> 5000 iters on a COPY of the committed
+# checkpoint and evaluated single-expert at both budgets.
+#
+# Schedule caveat, stated up front: the extension is a WARM RESTART — the
+# original run's cosine schedule (1e-3 over 2500) had decayed to its 5%
+# floor; resuming with --iterations 5000 re-raises lr to the cosine(5000)
+# value at iter 2500 (~5.2e-4).  The claim is "more optimization at the
+# same data", not schedule purity; a clean 5000-iter run costs 5h this
+# container doesn't have.
+set -e
+cd "$(dirname "$0")/.."
+
+RES="96 128"
+EXT=ckpts/ckpt_r3e5k_synth0
+
+if [ ! -d "$EXT" ]; then
+  cp -r ckpts/ckpt_r3_expert_synth0 "$EXT"
+fi
+
+echo "=== budget curve: 1-scene gating (M=1, trivial) ($(date)) ==="
+if [ ! -d ckpts/ckpt_bc_gating ]; then
+  python train_gating.py synth0 --cpu --size ref --frames 64 --res $RES \
+    --iterations 100 --learningrate 1e-3 --batch 8 \
+    --output ckpts/ckpt_bc_gating
+fi
+
+echo "=== budget curve: eval @2500 (committed ckpt) ($(date)) ==="
+python test_esac.py synth0 --cpu --size ref --frames 48 --res $RES \
+  --experts ckpts/ckpt_r3_expert_synth0 --gating ckpts/ckpt_bc_gating \
+  --hypotheses 256 --refine-iters 8 --json .budget_2500.json
+
+echo "=== budget curve: extend 2500 -> 5000 ($(date)) ==="
+python train_expert.py synth0 --cpu --size ref --frames 1024 --res $RES \
+  --iterations 5000 --learningrate 1e-3 --batch 8 \
+  --checkpoint-every 250 --resume --output "$EXT"
+
+echo "=== budget curve: eval @5000 ($(date)) ==="
+python test_esac.py synth0 --cpu --size ref --frames 48 --res $RES \
+  --experts "$EXT" --gating ckpts/ckpt_bc_gating \
+  --hypotheses 256 --refine-iters 8 --json .budget_5000.json
+
+echo "=== budget curve done ($(date)) ==="

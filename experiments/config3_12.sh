@@ -1,0 +1,74 @@
+#!/bin/sh
+# Acceptance config #3 (BASELINE.md: "12-Scenes: 12 experts, 1024
+# hypotheses vmap'd, gradient through soft-inlier") — the one acceptance
+# config with no committed artifact (VERDICT r5 #5).  The 12-scene
+# analogue runs the REAL 3-stage CLI end to end at a CPU-feasible preset
+# (test-size nets, 48x64): 12 experts, gating, a short stage-3 leg that
+# exercises the gradient through the soft-inlier scores at this exact
+# ensemble shape (dense estimator = exact gating gradient), then
+# dual-backend evals.  Hypothesis budget: 1024 TOTAL across the ensemble
+# (85 x 12 = 1020 realized with static per-expert allocation; the cpp
+# gated loop draws its 85*12 total from the gating distribution, which
+# is the reference's own semantics for "1024 hypotheses").  The claim is
+# existence + jax/cpp parity at the config's shape; the accuracy level is
+# whatever test-size nets give (EP50_DEMO.md's capacity-floor analysis
+# applies).
+set -e
+cd "$(dirname "$0")/.."
+
+SCENES=$(seq -f synth%g 0 11)
+EXPERTS=$(seq -f ckpts/ckpt_cfg3_%g 0 11)
+S3EXPERTS=$(seq -f ckpts/ckpt_cfg3_s3_expert%g 0 11)
+GATING=ckpts/ckpt_cfg3_gating
+RES="48 64"
+HYP=85
+
+resume_flag() {
+  if [ -d "$1/opt_state" ] || [ -d "$1.old/opt_state" ]; then echo "--resume"; fi
+  return 0
+}
+
+echo "=== cfg3 stage 1: 12 experts ($(date)) ==="
+i=0
+for s in $SCENES; do
+  ck="ckpts/ckpt_cfg3_$i"
+  python train_expert.py "$s" --cpu --size test --frames 96 --res $RES \
+    --iterations 1500 --learningrate 2e-3 --batch 8 \
+    --checkpoint-every 500 $(resume_flag "$ck") --output "$ck"
+  i=$((i+1))
+done
+
+echo "=== cfg3 stage 2: gating over 12 ($(date)) ==="
+python train_gating.py $SCENES --cpu --size test --frames 48 --res $RES \
+  --iterations 4000 --learningrate 1e-3 --batch 8 \
+  --checkpoint-every 1000 $(resume_flag "$GATING") --output "$GATING"
+
+echo "=== cfg3 eval: stage 2, jax ($(date)) ==="
+python test_esac.py $SCENES --cpu --size test --frames 8 --res $RES \
+  --experts $EXPERTS --gating "$GATING" --hypotheses $HYP \
+  --json .config3_stage2_jax.json
+
+echo "=== cfg3 eval: stage 2, cpp ($(date)) ==="
+python test_esac.py $SCENES --cpu --size test --frames 8 --res $RES \
+  --experts $EXPERTS --gating "$GATING" --hypotheses $HYP --backend cpp \
+  --json .config3_stage2_cpp.json
+
+echo "=== cfg3 stage 3: gradient through soft-inlier at 12x$HYP ($(date)) ==="
+python train_esac.py $SCENES --cpu --size test --frames 96 --res $RES \
+  --iterations 100 --learningrate 3e-6 --batch 4 --hypotheses $HYP \
+  --clip-norm 1.0 --alpha-start 0.1 \
+  --experts $EXPERTS --gating "$GATING" \
+  --checkpoint-every 50 $(resume_flag ckpts/ckpt_cfg3_s3_state) \
+  --output ckpts/ckpt_cfg3_s3
+
+echo "=== cfg3 eval: stage 3, jax ($(date)) ==="
+python test_esac.py $SCENES --cpu --size test --frames 8 --res $RES \
+  --experts $S3EXPERTS --gating ckpts/ckpt_cfg3_s3_gating --hypotheses $HYP \
+  --json .config3_stage3_jax.json
+
+echo "=== cfg3 eval: stage 3, cpp ($(date)) ==="
+python test_esac.py $SCENES --cpu --size test --frames 8 --res $RES \
+  --experts $S3EXPERTS --gating ckpts/ckpt_cfg3_s3_gating --hypotheses $HYP \
+  --backend cpp --json .config3_stage3_cpp.json
+
+echo "=== cfg3 done ($(date)) ==="

@@ -1,24 +1,36 @@
 #!/bin/sh
-# Reference-scale config-#2 pipeline (BASELINE.md: gating + M experts) on the
-# real chip, through the REAL entry points -- the accuracy half of the
-# acceptance criteria at reference-like scale.
+# TPU job 3 of tools/chip_recovery.sh's post-probe queue (round-5 ordering,
+# VERDICT r5 #3/#4): cheap fresh-evidence jobs FIRST so a short healthy
+# window still lands round-5 hardware numbers, then the long accuracy
+# pipeline.
 #
-# 4 synthetic scenes (distinct textures), ref-size nets, 192x256 renders:
+#   3a. tools/tpu_bench_refresh.py  -> fresh BENCH_TPU.json (config #1 +
+#       streaming #5, new recorded_at)  [minutes]
+#   3b. reference-scale config-#2 pipeline (below)          [hours, resumable]
+#
+# (Jobs 1-2 of the queue — tools/pallas_ab.py scoring A/B and
+# experiments/profile_stages.py hardware stage breakdown — run before this
+# script; see tools/chip_recovery.sh.)
+#
+# The pipeline: 4 synthetic scenes (distinct textures), ref-size nets,
+# 192x256 renders through the REAL entry points —
 #   stage 1: 4 experts x 12k iters   stage 2: gating 3k iters
-#   stage 3: end-to-end fine-tune    eval: test_esac.py, jax AND cpp backends
+#   stage 3: end-to-end fine-tune    eval: test_esac.py, jax AND cpp
 #
-# WEDGE SAFETY: launch detached (setsid nohup sh experiments/ref_scale_pipeline.sh
-# > .ref_pipeline.log 2>&1 &) and NEVER kill it -- it owns the TPU while alive
-# (CLAUDE.md hazards).  Progress is line-buffered into the log.
+# WEDGE SAFETY: launch detached (setsid nohup sh ... > .ref_pipeline.log
+# 2>&1 &) and NEVER kill it — it owns the TPU while alive (CLAUDE.md).
 #
 # STALL SAFETY: every trainer passes --checkpoint-every, and a relaunch of
 # this script resumes each stage from its last periodic checkpoint (the
-# relay has been observed to freeze mid-run; CLAUDE.md hazards).
+# relay freezes mid-run; CLAUDE.md hazards).
 set -e
 cd "$(dirname "$0")/.."
 
+echo "=== 3a: BENCH_TPU.json refresh ($(date)) ==="
+python tools/tpu_bench_refresh.py || echo "bench refresh failed rc=$?"
+
 SCENES="synth0 synth1 synth2 synth3"
-EXPERTS="ckpt_ref_expert_synth0 ckpt_ref_expert_synth1 ckpt_ref_expert_synth2 ckpt_ref_expert_synth3"
+EXPERTS="ckpts/ckpt_ref_expert_synth0 ckpts/ckpt_ref_expert_synth1 ckpts/ckpt_ref_expert_synth2 ckpts/ckpt_ref_expert_synth3"
 RES="192 256"
 
 # --resume only when a resume-capable checkpoint exists (first launch has none).
@@ -29,7 +41,7 @@ resume_flag() {
 
 echo "=== stage 1: experts ($(date)) ==="
 for s in $SCENES; do
-  ck="ckpt_ref_expert_$s"
+  ck="ckpts/ckpt_ref_expert_$s"
   echo "--- expert $s ---"
   python train_expert.py "$s" --size ref --frames 2048 --res $RES \
     --iterations 12000 --learningrate 1e-3 --batch 8 \
@@ -39,31 +51,32 @@ done
 echo "=== stage 2: gating ($(date)) ==="
 python train_gating.py $SCENES --size ref --frames 1024 --res $RES \
   --iterations 3000 --learningrate 1e-3 --batch 8 \
-  --checkpoint-every 1000 $(resume_flag ckpt_ref_gating) --output ckpt_ref_gating
+  --checkpoint-every 1000 $(resume_flag ckpts/ckpt_ref_gating) \
+  --output ckpts/ckpt_ref_gating
 
 echo "=== eval before stage 3, jax backend ($(date)) ==="
 python test_esac.py $SCENES --size ref --frames 64 --res $RES \
-  --experts $EXPERTS --gating ckpt_ref_gating --hypotheses 256 \
+  --experts $EXPERTS --gating ckpts/ckpt_ref_gating --hypotheses 256 \
   --json .ref_eval_stage2_jax.json
 
 echo "=== stage 3: end-to-end ($(date)) ==="
-# lr 1e-6: from STRONG stage-1 baselines, stage-3 at 1e-5 measurably
-# regresses accuracy while 1e-6 preserves-or-improves it
-# (CPU_SCALE_EVAL.json stage3 sweep; experiments/generalization.py notes).
+# S3_RECIPE.md settings: clip is load-bearing, lr <=3e-6 preserves a strong
+# baseline, alpha-start anneal spreads the early selection gradient.
 python train_esac.py $SCENES --size ref --frames 512 --res $RES \
-  --iterations 400 --learningrate 1e-6 --batch 2 --hypotheses 64 \
-  --checkpoint-every 100 $(resume_flag ckpt_ref_esac_state) \
-  --experts $EXPERTS --gating ckpt_ref_gating --output ckpt_ref_esac
+  --iterations 400 --learningrate 3e-6 --batch 2 --hypotheses 64 \
+  --clip-norm 1.0 --alpha-start 0.1 \
+  --checkpoint-every 100 $(resume_flag ckpts/ckpt_ref_esac_state) \
+  --experts $EXPERTS --gating ckpts/ckpt_ref_gating --output ckpts/ckpt_ref_esac
 
-E3="ckpt_ref_esac_expert0 ckpt_ref_esac_expert1 ckpt_ref_esac_expert2 ckpt_ref_esac_expert3"
+E3="ckpts/ckpt_ref_esac_expert0 ckpts/ckpt_ref_esac_expert1 ckpts/ckpt_ref_esac_expert2 ckpts/ckpt_ref_esac_expert3"
 echo "=== eval after stage 3, jax backend ($(date)) ==="
 python test_esac.py $SCENES --size ref --frames 64 --res $RES \
-  --experts $E3 --gating ckpt_ref_esac_gating --hypotheses 256 \
+  --experts $E3 --gating ckpts/ckpt_ref_esac_gating --hypotheses 256 \
   --json .ref_eval_stage3_jax.json
 
 echo "=== eval after stage 3, cpp backend ($(date)) ==="
 python test_esac.py $SCENES --size ref --frames 64 --res $RES \
-  --experts $E3 --gating ckpt_ref_esac_gating --hypotheses 256 --backend cpp \
+  --experts $E3 --gating ckpts/ckpt_ref_esac_gating --hypotheses 256 --backend cpp \
   --json .ref_eval_stage3_cpp.json
 
 echo "=== pipeline done ($(date)) ==="

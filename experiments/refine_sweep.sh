@@ -1,0 +1,23 @@
+#!/bin/sh
+# Refine-iters sensitivity sweep (VERDICT r4 weak #4 / next #6): the
+# reference refines the winning pose to convergence, capped ~100 IRLS
+# rounds (SURVEY.md §3.5 [P-med]); RansacConfig.refine_iters has been a
+# guessed 8 since round 1.  Evaluate the committed R3 ref-scale
+# checkpoints (R3_SCALE_EVAL.json's 21.53% row was refine_iters=8) at
+# 8/16/32/64 — eval-time only, no training — to learn whether accuracy is
+# being left on the table for a constant.  Writes .refine_sweep_{N}.json;
+# the refine_iters=8 leg must reproduce R3_SCALE_EVAL.json exactly (same
+# checkpoints, same seed-free eval), which doubles as a pipeline pin.
+set -e
+cd "$(dirname "$0")/.."
+
+SCENES="synth0 synth1 synth2"
+EXPERTS="ckpts/ckpt_r3_expert_synth0 ckpts/ckpt_r3_expert_synth1 ckpts/ckpt_r3_expert_synth2"
+
+for R in 8 16 32 64; do
+  echo "=== refine sweep: refine_iters=$R ($(date)) ==="
+  python test_esac.py $SCENES --cpu --size ref --frames 48 --res 96 128 \
+    --experts $EXPERTS --gating ckpts/ckpt_r3_gating --hypotheses 256 \
+    --refine-iters $R --json .refine_sweep_$R.json
+done
+echo "=== refine sweep done ($(date)) ==="

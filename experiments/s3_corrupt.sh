@@ -1,0 +1,66 @@
+#!/bin/sh
+# The corrupted-supervision stage-3 experiment (VERDICT r5 #1): make
+# end-to-end training WIN, not merely preserve.
+#
+# S3_RECIPE.md's negative result came with a hypothesis: on synthetic
+# scenes whose stage-1 supervision is PERFECT, the pose loss has nothing
+# left to teach; the reference's stage-3 wins come from real-sensor
+# miscalibration the synthetic pipeline didn't model.  This script models
+# it: fine-tune the committed R3 ref-size experts (21.53% 5cm/5deg,
+# R3_SCALE_EVAL.json) against supervision from a miscalibrated depth
+# sensor (train_expert.py --depth-scale 1.05: every camera-space target
+# at 105% of its true depth — a plausible uncalibrated-Kinect scale
+# error), confirm stage-2 eval degrades, then run stage 3 with the
+# S3_RECIPE-proven settings and show the pose loss repairs what corrupted
+# supervision broke.  Stage 3 has access to exactly what the reference's
+# does: ground-truth poses and true intrinsics, NOT the corrupted depth.
+#
+# All evals pin --refine-iters 8 so every row is comparable with the
+# committed 21.53% baseline (which ran at the refine_iters=8 default).
+set -e
+cd "$(dirname "$0")/.."
+
+SCENES="synth0 synth1 synth2"
+RES="96 128"
+DS=1.05
+CORRUPT="ckpts/ckpt_r5c_expert_synth0 ckpts/ckpt_r5c_expert_synth1 ckpts/ckpt_r5c_expert_synth2"
+REPAIR="ckpts/ckpt_r5c_s3_expert0 ckpts/ckpt_r5c_s3_expert1 ckpts/ckpt_r5c_s3_expert2"
+
+resume_flag() {
+  if [ -d "$1/opt_state" ] || [ -d "$1.old/opt_state" ]; then echo "--resume"; fi
+  return 0
+}
+
+echo "=== s3c stage 1': corrupt-finetune (depth_scale=$DS) ($(date)) ==="
+for s in $SCENES; do
+  ck="ckpts/ckpt_r5c_expert_$s"
+  python train_expert.py "$s" --cpu --size ref --frames 1024 --res $RES \
+    --iterations 250 --learningrate 5e-4 --batch 8 --depth-scale $DS \
+    --init-from ckpts/ckpt_r3_expert_$s \
+    --checkpoint-every 100 $(resume_flag "$ck") --output "$ck"
+done
+
+echo "=== s3c eval: corrupted stage-2, jax ($(date)) ==="
+python test_esac.py $SCENES --cpu --size ref --frames 48 --res $RES \
+  --experts $CORRUPT --gating ckpts/ckpt_r3_gating --hypotheses 256 \
+  --refine-iters 8 --json .s3c_corrupt_jax.json
+
+echo "=== s3c stage 3: repair (lr 1e-5, clip 1.0, alpha 0.1->0.5) ($(date)) ==="
+python train_esac.py $SCENES --cpu --size ref --frames 1024 --res $RES \
+  --iterations 300 --learningrate 1e-5 --batch 4 --hypotheses 64 \
+  --clip-norm 1.0 --alpha-start 0.1 \
+  --experts $CORRUPT --gating ckpts/ckpt_r3_gating \
+  --checkpoint-every 50 $(resume_flag ckpts/ckpt_r5c_s3_state) \
+  --output ckpts/ckpt_r5c_s3
+
+echo "=== s3c eval: repaired stage-3, jax ($(date)) ==="
+python test_esac.py $SCENES --cpu --size ref --frames 48 --res $RES \
+  --experts $REPAIR --gating ckpts/ckpt_r5c_s3_gating --hypotheses 256 \
+  --refine-iters 8 --json .s3c_repaired_jax.json
+
+echo "=== s3c eval: repaired stage-3, cpp ($(date)) ==="
+python test_esac.py $SCENES --cpu --size ref --frames 48 --res $RES \
+  --experts $REPAIR --gating ckpts/ckpt_r5c_s3_gating --hypotheses 256 \
+  --refine-iters 8 --backend cpp --json .s3c_repaired_cpp.json
+
+echo "=== s3c done ($(date)) ==="
