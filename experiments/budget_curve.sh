@@ -19,7 +19,11 @@ RES="96 128"
 EXT=ckpts/ckpt_r3e5k_synth0
 
 if [ ! -d "$EXT" ]; then
-  cp -r ckpts/ckpt_r3_expert_synth0 "$EXT"
+  # Copy via temp + mv so an interrupted copy can't leave a half-checkpoint
+  # that --resume then chokes on forever (r5 review).
+  rm -rf "$EXT.tmp"
+  cp -r ckpts/ckpt_r3_expert_synth0 "$EXT.tmp"
+  mv "$EXT.tmp" "$EXT"
 fi
 
 echo "=== budget curve: 1-scene gating (M=1, trivial) ($(date)) ==="

@@ -11,7 +11,7 @@
 # tool check the near-tie explanation directly).
 #
 # Budgeted from the measured 0.45 s/iter (small, 96x128, batch 8, quiet
-# core): 50 experts x 1000 iters ~ 6.3 h + gating + 3 evals.  Every stage
+# core): 50 experts x 900 iters ~ 5.7 h (trimmed from the probe's 1000 to fit the round-5 wall clock alongside the stage-3 experiment) + gating + 3 evals.  Every stage
 # resumable; a relaunch no-ops through finished experts.
 set -e
 cd "$(dirname "$0")/.."
@@ -31,7 +31,7 @@ i=0
 for s in $SCENES; do
   ck="ckpts/ckpt_ep50s_$i"
   python train_expert.py "$s" --cpu --size small --frames 256 --res $RES \
-    --iterations 1000 --learningrate 1e-3 --batch 8 \
+    --iterations 900 --learningrate 1e-3 --batch 8 \
     --checkpoint-every 250 $(resume_flag "$ck") --output "$ck"
   i=$((i+1))
 done

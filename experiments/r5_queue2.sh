@@ -1,0 +1,33 @@
+#!/bin/sh
+# Round-5 queue, take 2 (replaces r5_queue.sh after the depth-scale
+# corruption turned out to be a robustness finding instead of a broken
+# baseline — see experiments/s3_corrupt_map.sh header).  Same discipline:
+# ONE job at a time, pgid in .pipeline.pid, stages failure-isolated,
+# everything resumable.
+#
+#   setsid nohup nice -n 10 sh experiments/r5_queue2.sh > .r5_queue2.log 2>&1 &
+cd "$(dirname "$0")/.."
+# Single-instance guard (r5 review: a double launch raced two trainers on
+# one checkpoint's staging dir): refuse to start while .pipeline.pid names
+# a live process group, and only remove the pidfile if still ours.
+if [ -f .pipeline.pid ] && kill -0 "$(cat .pipeline.pid)" 2>/dev/null; then
+  echo "[r5_queue2] another queue owns .pipeline.pid ($(cat .pipeline.pid)); refusing to start"
+  exit 1
+fi
+echo $$ > .pipeline.pid
+trap '[ "$(cat .pipeline.pid 2>/dev/null)" = "$$" ] && rm -f .pipeline.pid; exit' EXIT INT TERM
+
+run() {
+  echo "[r5_queue2] START $1 ($(date))"
+  sh "$1" || echo "[r5_queue2] FAILED $1 rc=$? ($(date))"
+}
+
+run experiments/s3_corrupt_map.sh        # VERDICT #1: make stage 3 WIN
+run experiments/ep50_small96.sh          # VERDICT #2: config #4 at strength
+run experiments/config3_12.sh            # VERDICT #5: the artifact-less config
+echo "[r5_queue2] START routed_train_bench ($(date))"
+python tools/routed_train_bench.py \
+  || echo "[r5_queue2] FAILED routed_train_bench rc=$? ($(date))"  # VERDICT #7
+run experiments/s3_corrupt_leg2.sh       # gentle-lr hedge (map-scale ckpts)
+run experiments/budget_curve.sh          # VERDICT #8 (reached only if time)
+echo "[r5_queue2] queue done ($(date))"

@@ -27,7 +27,23 @@ set -e
 cd "$(dirname "$0")/.."
 
 echo "=== 3a: BENCH_TPU.json refresh ($(date)) ==="
-python tools/tpu_bench_refresh.py || echo "bench refresh failed rc=$?"
+# CLAUDE.md wrap rule: never run a chip-touching step inline with no
+# deadline.  The refresh runs detached and is POLLED (never killed); it
+# doubles as the window health gate — if it hangs (relay stalled again
+# between the probe and here) or fails, the hours-long pipeline below
+# would only mint zombie clients, so exit instead.
+python tools/tpu_bench_refresh.py > .bench_refresh.log 2>&1 &
+REFRESH=$!
+AGE=0
+while kill -0 $REFRESH 2>/dev/null && [ $AGE -lt 1200 ]; do
+  sleep 15; AGE=$((AGE+15))
+done
+if kill -0 $REFRESH 2>/dev/null; then
+  echo "bench refresh hung ${AGE}s: relay stalled; orphaning it (never "
+  echo "killed) and forfeiting this window before minting more zombies"
+  exit 1
+fi
+wait $REFRESH || { echo "bench refresh failed (see .bench_refresh.log); window unhealthy"; exit 1; }
 
 SCENES="synth0 synth1 synth2 synth3"
 EXPERTS="ckpts/ckpt_ref_expert_synth0 ckpts/ckpt_ref_expert_synth1 ckpts/ckpt_ref_expert_synth2 ckpts/ckpt_ref_expert_synth3"

@@ -1,6 +1,6 @@
 #!/bin/sh
 # Second stage-3 repair leg for the corrupted-supervision experiment
-# (experiments/s3_corrupt.sh must have run first: reuses its corrupted
+# (experiments/s3_corrupt_map.sh must have run first: reuses its corrupted
 # checkpoints): the gentler S3_RECIPE "anneal" settings (lr 3e-6), run
 # longer.  Hedge in case lr 1e-5 over-corrects; also a data point on
 # repair-rate vs lr.  Evals pinned to --refine-iters 8 like every row of
@@ -10,8 +10,8 @@ cd "$(dirname "$0")/.."
 
 SCENES="synth0 synth1 synth2"
 RES="96 128"
-CORRUPT="ckpts/ckpt_r5c_expert_synth0 ckpts/ckpt_r5c_expert_synth1 ckpts/ckpt_r5c_expert_synth2"
-REPAIR2="ckpts/ckpt_r5c_s3b_expert0 ckpts/ckpt_r5c_s3b_expert1 ckpts/ckpt_r5c_s3b_expert2"
+CORRUPT="ckpts/ckpt_r5m_expert_synth0 ckpts/ckpt_r5m_expert_synth1 ckpts/ckpt_r5m_expert_synth2"
+REPAIR2="ckpts/ckpt_r5m_s3b_expert0 ckpts/ckpt_r5m_s3b_expert1 ckpts/ckpt_r5m_s3b_expert2"
 
 resume_flag() {
   if [ -d "$1/opt_state" ] || [ -d "$1.old/opt_state" ]; then echo "--resume"; fi
@@ -23,12 +23,12 @@ python train_esac.py $SCENES --cpu --size ref --frames 1024 --res $RES \
   --iterations 400 --learningrate 3e-6 --batch 4 --hypotheses 64 \
   --clip-norm 1.0 --alpha-start 0.1 \
   --experts $CORRUPT --gating ckpts/ckpt_r3_gating \
-  --checkpoint-every 50 $(resume_flag ckpts/ckpt_r5c_s3b_state) \
-  --output ckpts/ckpt_r5c_s3b
+  --checkpoint-every 50 $(resume_flag ckpts/ckpt_r5m_s3b_state) \
+  --output ckpts/ckpt_r5m_s3b
 
 echo "=== s3c leg2 eval: jax ($(date)) ==="
 python test_esac.py $SCENES --cpu --size ref --frames 48 --res $RES \
-  --experts $REPAIR2 --gating ckpts/ckpt_r5c_s3b_gating --hypotheses 256 \
-  --refine-iters 8 --json .s3c_repaired2_jax.json
+  --experts $REPAIR2 --gating ckpts/ckpt_r5m_s3b_gating --hypotheses 256 \
+  --refine-iters 8 --json .s3m_repaired2_jax.json
 
 echo "=== s3c leg2 done ($(date)) ==="

@@ -1,0 +1,72 @@
+#!/bin/sh
+# The corrupted-supervision stage-3 experiment, take 2 (VERDICT r5 #1).
+#
+# Take 1 (experiments/s3_corrupt.sh, artifacts .s3c_corrupt_jax.json)
+# produced a genuine ROBUSTNESS finding instead of a degraded baseline:
+# per-frame camera-space depth scaling (--depth-scale 1.05) left eval at
+# the 21.5% baseline — the corruption X' = s X - (s-1) C_k has a view-
+# INCONSISTENT offset the net averages away, and its consistent residual
+# is reprojection-aligned with each training view.  Committed as-is: the
+# pipeline shrugs off 5% per-frame depth miscalibration out of the box.
+#
+# Take 2 corrupts what a net CAN fit and a pose eval MUST see: a map/
+# reconstruction scale error, view-consistent by construction (SfM scale
+# drift — the outdoor/Aachen failure mode).  --map-scale 1.08 scales every
+# supervision target about the scene center; stage 1 fits the wrong map
+# exactly, stage-2 eval degrades (translation biased ~8% of the camera-to-
+# center distance), then stage 3 — which sees true poses and intrinsics,
+# never the corrupted map, exactly like the reference's e2e stage — must
+# shrink the map back.  Evals pin --refine-iters 8 (comparable with the
+# 21.53% R3_SCALE_EVAL baseline).
+set -e
+cd "$(dirname "$0")/.."
+
+SCENES="synth0 synth1 synth2"
+RES="96 128"
+MS=1.08
+CORRUPT="ckpts/ckpt_r5m_expert_synth0 ckpts/ckpt_r5m_expert_synth1 ckpts/ckpt_r5m_expert_synth2"
+REPAIR="ckpts/ckpt_r5m_s3_expert0 ckpts/ckpt_r5m_s3_expert1 ckpts/ckpt_r5m_s3_expert2"
+
+resume_flag() {
+  if [ -d "$1/opt_state" ] || [ -d "$1.old/opt_state" ]; then echo "--resume"; fi
+  return 0
+}
+
+echo "=== s3m stage 1': corrupt-finetune (map_scale=$MS) ($(date)) ==="
+for s in $SCENES; do
+  ck="ckpts/ckpt_r5m_expert_$s"
+  python train_expert.py "$s" --cpu --size ref --frames 1024 --res $RES \
+    --iterations 250 --learningrate 5e-4 --batch 8 --map-scale $MS \
+    --init-from ckpts/ckpt_r3_expert_$s \
+    --checkpoint-every 100 $(resume_flag "$ck") --output "$ck"
+done
+
+echo "=== s3m eval: corrupted stage-2, jax ($(date)) ==="
+python test_esac.py $SCENES --cpu --size ref --frames 48 --res $RES \
+  --experts $CORRUPT --gating ckpts/ckpt_r3_gating --hypotheses 256 \
+  --refine-iters 8 --json .s3m_corrupt_jax.json
+
+echo "=== s3m eval: corrupted stage-2, cpp ($(date)) ==="
+python test_esac.py $SCENES --cpu --size ref --frames 48 --res $RES \
+  --experts $CORRUPT --gating ckpts/ckpt_r3_gating --hypotheses 256 \
+  --refine-iters 8 --backend cpp --json .s3m_corrupt_cpp.json
+
+echo "=== s3m stage 3: repair (lr 1e-5, clip 1.0, alpha 0.1->0.5) ($(date)) ==="
+python train_esac.py $SCENES --cpu --size ref --frames 1024 --res $RES \
+  --iterations 400 --learningrate 1e-5 --batch 4 --hypotheses 64 \
+  --clip-norm 1.0 --alpha-start 0.1 \
+  --experts $CORRUPT --gating ckpts/ckpt_r3_gating \
+  --checkpoint-every 50 $(resume_flag ckpts/ckpt_r5m_s3_state) \
+  --output ckpts/ckpt_r5m_s3
+
+echo "=== s3m eval: repaired stage-3, jax ($(date)) ==="
+python test_esac.py $SCENES --cpu --size ref --frames 48 --res $RES \
+  --experts $REPAIR --gating ckpts/ckpt_r5m_s3_gating --hypotheses 256 \
+  --refine-iters 8 --json .s3m_repaired_jax.json
+
+echo "=== s3m eval: repaired stage-3, cpp ($(date)) ==="
+python test_esac.py $SCENES --cpu --size ref --frames 48 --res $RES \
+  --experts $REPAIR --gating ckpts/ckpt_r5m_s3_gating --hypotheses 256 \
+  --refine-iters 8 --backend cpp --json .s3m_repaired_cpp.json
+
+echo "=== s3m done ($(date)) ==="
