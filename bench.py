@@ -540,7 +540,14 @@ def _contention_block(stopped: list[int], load_before: list[float]) -> dict:
         "note": "repo background pipelines are SIGSTOPped during "
                 "measurement and resumed after; loadavg is 1/5/15-min "
                 "pre-pause (>~1.0 on this 1-core box means the value "
-                "would have recorded contention without the pause)",
+                "would have recorded contention without the pause).  "
+                "Cross-round CPU drift context: r01's 11.6k remains the "
+                "quiet-box high-water mark; later rounds measure 8.4-9.5k "
+                "with the pause active and nonzero pre-pause load — "
+                "container state (cache/thermal/cotenant) moves the CPU "
+                "value ~25% even when this process is the only runnable "
+                "one, so judge the per-run spread field, not cross-round "
+                "deltas",
     }
 
 

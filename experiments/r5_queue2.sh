@@ -9,13 +9,16 @@
 cd "$(dirname "$0")/.."
 # Single-instance guard (r5 review: a double launch raced two trainers on
 # one checkpoint's staging dir): refuse to start while .pipeline.pid names
-# a live process group, and only remove the pidfile if still ours.
-if [ -f .pipeline.pid ] && kill -0 "$(cat .pipeline.pid)" 2>/dev/null; then
-  echo "[r5_queue2] another queue owns .pipeline.pid ($(cat .pipeline.pid)); refusing to start"
+# a live process GROUP (kill -0 -PGID sees orphaned children too, not just
+# the queue shell), and on exit remove the pidfile only if it is still
+# ours AND no other group member survives us — a pid-only kill of the
+# shell must not delete the file while a trainer child is still writing.
+if [ -f .pipeline.pid ] && kill -0 -- "-$(cat .pipeline.pid)" 2>/dev/null; then
+  echo "[r5_queue2] another queue group owns .pipeline.pid ($(cat .pipeline.pid)); refusing to start"
   exit 1
 fi
 echo $$ > .pipeline.pid
-trap '[ "$(cat .pipeline.pid 2>/dev/null)" = "$$" ] && rm -f .pipeline.pid; exit' EXIT INT TERM
+trap 'if [ "$(cat .pipeline.pid 2>/dev/null)" = "$$" ] && [ -z "$(pgrep -g $$ | grep -vx $$)" ]; then rm -f .pipeline.pid; fi; exit' EXIT INT TERM
 
 run() {
   echo "[r5_queue2] START $1 ($(date))"

@@ -156,3 +156,30 @@ def test_train_esac_sharded_rejects_sampled(pipeline_ckpts, tmp_path):
     )
     assert r.returncode != 0
     assert "dense estimator" in r.stderr
+
+
+def test_train_expert_corruption_and_init_from(pipeline_ckpts, tmp_path):
+    """--map-scale / --depth-scale / --init-from (the corrupted-supervision
+    stage-3 experiment's hooks, experiments/s3_corrupt_map.sh): the flags
+    run end to end, the checkpoint records the corruption settings, and the
+    size guard rejects a mismatched --init-from."""
+    import json
+
+    d = pipeline_ckpts
+    out = run("train_expert.py", "synth0", "--cpu", "--size", "test",
+              "--batch", "2", "--iterations", "2", "--map-scale", "1.5",
+              "--init-from", str(d / "e0"), "--output", str(tmp_path / "ms"))
+    assert "initialized params from" in out
+    cfg = json.loads((tmp_path / "ms" / "config.json").read_text())
+    assert cfg["map_scale"] == 1.5 and cfg["depth_scale"] == 1.0
+    # size-mismatch guard: --init-from a test-size ckpt into --size small
+    r = subprocess.run([sys.executable, str(REPO / "train_expert.py"),
+                        "synth0", "--cpu", "--size", "small",
+                        "--iterations", "1", "--init-from", str(d / "e0"),
+                        "--output", str(tmp_path / "bad")],
+                       capture_output=True, text=True, cwd=REPO, timeout=900)
+    assert r.returncode != 0 and "size" in r.stderr
+    # depth-scale path also runs end to end
+    run("train_expert.py", "synth0", "--cpu", "--size", "test",
+        "--batch", "2", "--iterations", "2", "--depth-scale", "1.1",
+        "--output", str(tmp_path / "ds"))

@@ -87,6 +87,10 @@ def main(argv=None) -> int:
         p.error(f"scene {args.scene} has no GT coordinates; use --loss reproj")
     if mode == "reproj" and args.augment:
         p.error("--augment requires GT coordinates (coords mode)")
+    if mode == "reproj" and (args.depth_scale != 1.0 or args.map_scale != 1.0):
+        p.error("--depth-scale/--map-scale corrupt GT coordinates and are "
+                "coords-mode only (reproj mode has no coordinate targets "
+                "to corrupt — the flag would be recorded but never applied)")
 
     probe = batch_frames(ds, np.array([0]))
     params = net.init(jax.random.key(args.seed), probe["images"])
