@@ -18,9 +18,11 @@ resume_flag() {
   return 0
 }
 
-echo "=== s3c leg2: repair at lr 3e-6, 400 iters ($(date)) ==="
+echo "=== s3c leg2: repair at lr 3e-6, 150 iters ($(date)) ==="
+# Same measured-cost sizing as leg 1 (s3_corrupt_map.sh): batch 2 x 16
+# hyps — batch 4 x 64 measured ~60 s/iter on this core.
 python train_esac.py $SCENES --cpu --size ref --frames 1024 --res $RES \
-  --iterations 400 --learningrate 3e-6 --batch 4 --hypotheses 64 \
+  --iterations 150 --learningrate 3e-6 --batch 2 --hypotheses 16 \
   --clip-norm 1.0 --alpha-start 0.1 \
   --experts $CORRUPT --gating ckpts/ckpt_r3_gating \
   --checkpoint-every 50 $(resume_flag ckpts/ckpt_r5m_s3b_state) \
