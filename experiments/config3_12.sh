@@ -6,13 +6,17 @@
 # (test-size nets, 48x64): 12 experts, gating, a short stage-3 leg that
 # exercises the gradient through the soft-inlier scores at this exact
 # ensemble shape (dense estimator = exact gating gradient), then
-# dual-backend evals.  Hypothesis budget: 1024 TOTAL across the ensemble
-# (85 x 12 = 1020 realized with static per-expert allocation; the cpp
-# gated loop draws its 85*12 total from the gating distribution, which
-# is the reference's own semantics for "1024 hypotheses").  The claim is
-# existence + jax/cpp parity at the config's shape; the accuracy level is
-# whatever test-size nets give (EP50_DEMO.md's capacity-floor analysis
-# applies).
+# dual-backend evals.  Hypothesis budget: evals run 1024 hyps PER EXPERT
+# (12,288 total/frame) — the same reading the structural pin uses
+# (tests/test_esac.py::test_config3_shape_twelve_experts_1024_hyps
+# asserts scores shape (12, 1024)) and strictly stronger than a
+# 1024-total reading; the cpp gated loop draws 1024*12 from the gating
+# distribution.  The stage-3 leg trains at 128 hyps/expert (the gradient
+# through the soft-inlier scores at the full 12-expert shape; 1024 in
+# the training expectation is pure VJP cost with no extra claim).  The
+# claim is existence + jax/cpp parity at the config's shape; the
+# accuracy level is whatever test-size nets give (EP50_DEMO.md's
+# capacity-floor analysis applies).
 set -e
 cd "$(dirname "$0")/.."
 
@@ -21,7 +25,8 @@ EXPERTS=$(seq -f ckpts/ckpt_cfg3_%g 0 11)
 S3EXPERTS=$(seq -f ckpts/ckpt_cfg3_s3_expert%g 0 11)
 GATING=ckpts/ckpt_cfg3_gating
 RES="48 64"
-HYP=85
+HYP=1024
+TRAIN_HYP=128
 
 resume_flag() {
   if [ -d "$1/opt_state" ] || [ -d "$1.old/opt_state" ]; then echo "--resume"; fi
@@ -53,9 +58,9 @@ python test_esac.py $SCENES --cpu --size test --frames 8 --res $RES \
   --experts $EXPERTS --gating "$GATING" --hypotheses $HYP --backend cpp \
   --json .config3_stage2_cpp.json
 
-echo "=== cfg3 stage 3: gradient through soft-inlier at 12x$HYP ($(date)) ==="
+echo "=== cfg3 stage 3: gradient through soft-inlier at 12x$TRAIN_HYP ($(date)) ==="
 python train_esac.py $SCENES --cpu --size test --frames 96 --res $RES \
-  --iterations 100 --learningrate 3e-6 --batch 4 --hypotheses $HYP \
+  --iterations 100 --learningrate 3e-6 --batch 4 --hypotheses $TRAIN_HYP \
   --clip-norm 1.0 --alpha-start 0.1 \
   --experts $EXPERTS --gating "$GATING" \
   --checkpoint-every 50 $(resume_flag ckpts/ckpt_cfg3_s3_state) \
