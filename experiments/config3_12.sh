@@ -38,14 +38,14 @@ i=0
 for s in $SCENES; do
   ck="ckpts/ckpt_cfg3_$i"
   python train_expert.py "$s" --cpu --size test --frames 96 --res $RES \
-    --iterations 1500 --learningrate 2e-3 --batch 8 \
+    --iterations 1000 --learningrate 2e-3 --batch 8 \
     --checkpoint-every 500 $(resume_flag "$ck") --output "$ck"
   i=$((i+1))
 done
 
 echo "=== cfg3 stage 2: gating over 12 ($(date)) ==="
 python train_gating.py $SCENES --cpu --size test --frames 48 --res $RES \
-  --iterations 4000 --learningrate 1e-3 --batch 8 \
+  --iterations 2500 --learningrate 1e-3 --batch 8 \
   --checkpoint-every 1000 $(resume_flag "$GATING") --output "$GATING"
 
 echo "=== cfg3 eval: stage 2, jax ($(date)) ==="
@@ -60,7 +60,7 @@ python test_esac.py $SCENES --cpu --size test --frames 8 --res $RES \
 
 echo "=== cfg3 stage 3: gradient through soft-inlier at 12x$TRAIN_HYP ($(date)) ==="
 python train_esac.py $SCENES --cpu --size test --frames 96 --res $RES \
-  --iterations 100 --learningrate 3e-6 --batch 4 --hypotheses $TRAIN_HYP \
+  --iterations 75 --learningrate 3e-6 --batch 4 --hypotheses $TRAIN_HYP \
   --clip-norm 1.0 --alpha-start 0.1 \
   --experts $EXPERTS --gating "$GATING" \
   --checkpoint-every 50 $(resume_flag ckpts/ckpt_cfg3_s3_state) \

@@ -11,7 +11,7 @@
 # tool check the near-tie explanation directly).
 #
 # Budgeted from the measured 0.45 s/iter (small, 96x128, batch 8, quiet
-# core): 50 experts x 900 iters ~ 5.7 h (trimmed from the probe's 1000 to fit the round-5 wall clock alongside the stage-3 experiment) + gating + 3 evals.  Every stage
+# core): 50 experts x 800-900 iters (trimmed twice to fit the round-5 wall clock: experts 0-14 ran at 900 before the re-size, the rest at 800 - a heterogeneous budget, recorded here, that the ensemble-level metrics tolerate) + gating + 3 evals.  Every stage
 # resumable; a relaunch no-ops through finished experts.
 set -e
 cd "$(dirname "$0")/.."
@@ -31,7 +31,7 @@ i=0
 for s in $SCENES; do
   ck="ckpts/ckpt_ep50s_$i"
   python train_expert.py "$s" --cpu --size small --frames 256 --res $RES \
-    --iterations 900 --learningrate 1e-3 --batch 8 \
+    --iterations 800 --learningrate 1e-3 --batch 8 \
     --checkpoint-every 250 $(resume_flag "$ck") --output "$ck"
   i=$((i+1))
 done
@@ -40,7 +40,7 @@ echo "=== ep50s stage 2: gating over 50 scenes ($(date)) ==="
 # The round-4 gating-capacity finding (EP50_DEMO.md): the small gating
 # preset with lr 5e-4 and batch 16 is what routes a 50-way ensemble.
 python train_gating.py $SCENES --cpu --size small --frames 48 --res $RES \
-  --iterations 8000 --learningrate 5e-4 --batch 16 \
+  --iterations 6000 --learningrate 5e-4 --batch 16 \
   --checkpoint-every 1000 $(resume_flag "$GATING") --output "$GATING"
 
 echo "=== ep50s eval: sharded routed, capacity 2 ($(date)) ==="
