@@ -38,11 +38,19 @@ from esac_tpu.utils.num import safe_norm, safe_sqrt
 from esac_tpu.utils.precision import hmm
 
 def bearings(x2d: jnp.ndarray, f: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
-    """Pixels -> unit bearing vectors in the camera frame. (..., N, 2) -> (..., N, 3)."""
+    """Pixels -> unit bearing vectors in the camera frame. (..., N, 2) -> (..., N, 3).
+
+    safe_norm, not jnp.linalg.norm: the solve is differentiated wrt the
+    pixels/intrinsics in the training expectation, and a raw norm's VJP is
+    NaN at zero input — the z=1 homogeneous coordinate keeps the *forward*
+    norm >= 1, but the eps-inside-sqrt form costs nothing and keeps every
+    input (including garbage from upstream degeneracies) finite in both
+    passes, per the total + grad-safe convention.
+    """
     xy = (x2d - c) / f
     ones = jnp.ones_like(xy[..., :1])
     rays = jnp.concatenate([xy, ones], axis=-1)
-    return rays / jnp.linalg.norm(rays, axis=-1, keepdims=True)
+    return rays / safe_norm(rays)[..., None]
 
 
 def _p3p_depths(b3: jnp.ndarray, X3: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -61,9 +69,16 @@ def _p3p_depths(b3: jnp.ndarray, X3: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndar
     G = -c^2 v^2 + 2 c^2 cb v + (b^2 - c^2),   w = a^2 - c^2,
     Q = b^2 E^2 + 2 b^2 cg E D + G D^2.
     """
-    ca = jnp.dot(b3[1], b3[2])
-    cb = jnp.dot(b3[0], b3[2])
-    cg = jnp.dot(b3[0], b3[1])
+    # hmm, not bare jnp.dot: a dot_general on TPU defaults to bf16 MXU
+    # inputs, and these cosines seed the quartic — exactly the corruption
+    # hmm/heinsum exist to prevent (graft-lint R4/J3).  1-D x 1-D matmul is
+    # the inner product, bit-identical to the old jnp.dot on CPU (an
+    # elementwise mul+sum variant was tried and rejected: its one-ULP
+    # rounding difference flips the argmin between near-tied quartic
+    # branches on marginal P3P instances and regressed test_pnp seed 2).
+    ca = hmm(b3[1], b3[2])
+    cb = hmm(b3[0], b3[2])
+    cg = hmm(b3[0], b3[1])
     asq = jnp.sum((X3[1] - X3[2]) ** 2)
     bsq = jnp.sum((X3[0] - X3[2]) ** 2)
     csq = jnp.sum((X3[0] - X3[1]) ** 2)

@@ -1,0 +1,45 @@
+"""graft-lint: machine-checked TPU-safety invariants for this repo.
+
+The correctness of esac_tpu rests on a catalog of invariants that used to
+live only as prose (CLAUDE.md conventions, DESIGN.md, the SURVEY.md
+behavioral spec): grad-safe geometry via ``safe_norm``/``safe_sqrt``,
+precision pinned through ``hmm``/``heinsum``, no import-time device init,
+no scalar-looping linalg in vmapped hot paths, force-CPU guards in ad-hoc
+scripts, and never ``timeout``/``kill`` on a jax-on-TPU process.  This
+package checks them statically, in two layers:
+
+- **Layer 1** (:mod:`esac_tpu.lint.ast_rules`, :mod:`~.shell_rules`):
+  pure-AST rules R1-R6 over Python sources plus a line rule R7 over shell
+  scripts.  No jax import, runs in well under a second.
+- **Layer 2** (:mod:`esac_tpu.lint.jaxpr_audit`): jit-traces a registry of
+  real entry points on the CPU backend and audits the jaxprs themselves —
+  disallowed primitives, dynamic shapes, unpinned ``dot_general`` precision.
+
+Run ``python -m esac_tpu.lint`` (full tree) or ``--changed`` (git-diff
+scoped).  Rules support inline ``# graft-lint: disable=RULE(reason)``
+suppressions and a committed ``lint_baseline.json`` for grandfathered
+findings.  See LINT.md for the rule catalog and workflow.
+"""
+
+from esac_tpu.lint.findings import Finding, RULES
+from esac_tpu.lint.ast_rules import run_python_rules
+from esac_tpu.lint.shell_rules import run_shell_rules
+from esac_tpu.lint.suppress import Baseline, filter_suppressed
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "run_python_rules",
+    "run_shell_rules",
+    "Baseline",
+    "filter_suppressed",
+    "run_layer1",
+]
+
+
+def run_layer1(root, files=None):
+    """All layer-1 findings for the tree at ``root`` (inline suppressions
+    already applied, baseline NOT applied — callers decide)."""
+    findings = run_python_rules(root, files=files)
+    findings += run_shell_rules(root, files=files)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

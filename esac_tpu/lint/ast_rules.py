@@ -1,0 +1,539 @@
+"""Layer 1: AST rules R1-R6 over the repo's Python sources.
+
+Pure ``ast`` — no jax import, no execution — so the whole tree lints in
+well under a second.  Each rule is scoped by repo-relative path (the scope
+table mirrors LINT.md); inline ``# graft-lint: disable=RULE(reason)``
+suppressions are honored here, while the committed baseline is applied by
+the caller (:mod:`esac_tpu.lint.cli`).
+
+R3 is the one cross-file rule: a lightweight intra-package call graph marks
+every function reachable from a ``jax.jit``/``jax.vmap``/``shard_map``
+wrapper (decorator or call-site) and flags scalar-looping linalg inside the
+reachable set.  The graph over-approximates callees (any name called inside
+a reachable function body, nested lambdas included) and under-approximates
+dynamic dispatch (method calls through instances are not resolved) — the
+right trade for a lint: no false positives from dead code, and the hot
+paths here are plain functions.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from esac_tpu.lint.findings import Finding
+from esac_tpu.lint.suppress import is_suppressed, parse_suppressions
+
+# --------------------------------------------------------------------------
+# shared helpers
+
+_SKIP_DIRS = {".git", "__pycache__", ".venv", "build", "ckpts", "node_modules"}
+
+# Top-level packages whose import makes a script "jax-adjacent" (R6): their
+# import can reach jax backend init.  Repo-root entry scripts count — they
+# import jax transitively.
+_JAX_ADJACENT = {
+    "jax", "flax", "optax", "orbax", "esac_tpu",
+    "bench", "bench_accuracy", "train_esac", "train_expert", "train_gating",
+    "test_esac", "convert_checkpoint",
+}
+
+# Callables that make an argument function part of a jit/vmap hot path (R3).
+_JIT_WRAPPERS = {
+    "jax.jit", "jax.vmap", "jax.pmap", "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "esac_tpu.parallel.mesh.shard_map",  # the repo's compat alias
+    "jax.checkpoint", "jax.remat",
+    "jax.lax.scan", "jax.lax.map", "jax.grad", "jax.value_and_grad",
+    "jax.custom_vjp", "jax.custom_jvp",
+}
+
+# jnp.linalg / scipy.linalg callables that lower to scalar loops on TPU (R3).
+_SCALAR_LINALG = {
+    "svd", "solve", "inv", "pinv", "qr", "eig", "eigh", "eigvals",
+    "eigvalsh", "lstsq", "cholesky", "matrix_power", "slogdet",
+}
+
+# Unpinned contraction entry points (R4).
+_CONTRACTIONS = {
+    "jax.numpy.matmul", "jax.numpy.einsum", "jax.numpy.dot",
+    "jax.numpy.tensordot", "jax.numpy.inner", "jax.numpy.vdot",
+}
+
+
+def iter_python_files(root: pathlib.Path, files=None):
+    """Repo-relative posix paths of the .py files to lint."""
+    if files is not None:
+        for f in files:
+            rel = pathlib.Path(f)
+            if rel.is_absolute():
+                rel = rel.relative_to(root)
+            if rel.suffix == ".py" and (root / rel).exists():
+                yield rel.as_posix()
+        return
+    for p in sorted(root.rglob("*.py")):
+        rel = p.relative_to(root)
+        if any(part in _SKIP_DIRS for part in rel.parts):
+            continue
+        yield rel.as_posix()
+
+
+def _alias_map(tree: ast.AST) -> dict[str, str]:
+    """Name bound by an import -> fully dotted target, whole file."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def _dotted(node: ast.AST, aliases: dict[str, str]) -> str | None:
+    """Resolve an expression to a dotted name with import aliases expanded.
+
+    ``jnp.linalg.norm`` -> ``jax.numpy.linalg.norm`` (under
+    ``import jax.numpy as jnp``); returns None for non-name expressions.
+    """
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    parts.reverse()
+    head = aliases.get(parts[0], parts[0])
+    return ".".join([head] + parts[1:])
+
+
+def _walk_no_functions(node: ast.AST):
+    """ast.walk that does not descend into function/lambda bodies (but does
+    visit their decorators and default-argument expressions, which execute
+    at import time)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(n.decorator_list)
+            stack.extend(n.args.defaults)
+            stack.extend(d for d in n.args.kw_defaults if d is not None)
+            continue
+        if isinstance(n, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _force_cpu_guard_line(
+    tree: ast.AST, aliases: dict[str, str], module_level_only: bool = False
+) -> int | None:
+    """Line of ``jax.config.update("jax_platforms", "cpu")``, or None.
+
+    R1's import-time exemption needs ``module_level_only=True``: a guard
+    buried in a function body never runs at import, so it cannot make a
+    module-level array constant safe.  R6 accepts any placement — a script
+    that forces CPU at the top of ``main()`` still does so before first
+    device use.
+    """
+    walker = _walk_no_functions(tree) if module_level_only else ast.walk(tree)
+    for node in walker:
+        if not isinstance(node, ast.Call):
+            continue
+        if _dotted(node.func, aliases) != "jax.config.update":
+            continue
+        args = node.args
+        if (
+            len(args) >= 2
+            and isinstance(args[0], ast.Constant)
+            and args[0].value == "jax_platforms"
+            and isinstance(args[1], ast.Constant)
+            and args[1].value == "cpu"
+        ):
+            return node.lineno
+    return None
+
+
+def _line_text(lines: list[str], lineno: int) -> str:
+    if 1 <= lineno <= len(lines):
+        return lines[lineno - 1].strip()
+    return ""
+
+
+# --------------------------------------------------------------------------
+# rule scopes (repo-relative posix paths)
+
+def _in_tests(rel: str) -> bool:
+    return rel.startswith("tests/")
+
+
+def _r1_scope(rel: str) -> bool:
+    # tests/ is exempt: tests/conftest.py pins the CPU backend before jax is
+    # imported anywhere, so import-time constants there cannot touch the TPU.
+    return not _in_tests(rel)
+
+
+def _r2_scope(rel: str) -> bool:
+    return rel.startswith(
+        ("esac_tpu/geometry/", "esac_tpu/ransac/", "esac_tpu/train/")
+    )
+
+
+def _r4_scope(rel: str) -> bool:
+    return rel.startswith("esac_tpu/geometry/") or rel == "esac_tpu/ransac/refine.py"
+
+
+def _r5_scope(rel: str) -> bool:
+    return rel.startswith("esac_tpu/")
+
+
+def _r6_scope(rel: str) -> bool:
+    return rel.startswith(("tools/", "experiments/")) and rel.endswith(".py")
+
+
+def _r3_scope(rel: str) -> bool:
+    return rel.startswith("esac_tpu/")
+
+
+# --------------------------------------------------------------------------
+# per-file rules
+
+def _rule_r1(rel, tree, aliases, lines):
+    """Module-level jnp/jax array creation = import-time backend init."""
+    guard = _force_cpu_guard_line(tree, aliases, module_level_only=True)
+    out = []
+    for node in _walk_no_functions(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func, aliases)
+        if dotted is None:
+            continue
+        if dotted.startswith(("jax.numpy.", "jax.random.")) or dotted in (
+            "jax.device_put", "jax.devices", "jax.local_devices",
+        ):
+            # A module-level force-CPU guard executed first makes the init
+            # CPU-only — the sanctioned pattern for ad-hoc scripts.
+            if guard is not None and guard < node.lineno:
+                continue
+            out.append(Finding(
+                "R1", rel, node.lineno, _line_text(lines, node.lineno),
+                f"module-level {dotted.replace('jax.numpy', 'jnp')} call "
+                "initializes the device backend at import time; build with "
+                "numpy (or move inside a function)",
+            ))
+    return out
+
+
+def _eps_guarded(arg: ast.AST) -> bool:
+    """True for ``x + eps``-shaped sqrt arguments (eps inside the sqrt)."""
+    if not (isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add)):
+        return False
+    for side in (arg.left, arg.right):
+        if isinstance(side, ast.Constant) and isinstance(side.value, (int, float)):
+            return True
+        name = None
+        if isinstance(side, ast.Name):
+            name = side.id
+        elif isinstance(side, ast.Attribute):
+            name = side.attr
+        if name is not None and "eps" in name.lower():
+            return True
+    return False
+
+
+def _rule_r2(rel, tree, aliases, lines):
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func, aliases)
+        if dotted == "jax.numpy.linalg.norm":
+            out.append(Finding(
+                "R2", rel, node.lineno, _line_text(lines, node.lineno),
+                "raw jnp.linalg.norm in differentiated geometry NaNs the "
+                "VJP at zero input; use utils.num.safe_norm",
+            ))
+        elif dotted == "jax.numpy.sqrt":
+            if node.args and _eps_guarded(node.args[0]):
+                continue
+            out.append(Finding(
+                "R2", rel, node.lineno, _line_text(lines, node.lineno),
+                "bare jnp.sqrt has an infinite VJP at 0; use "
+                "utils.num.safe_sqrt or put an eps inside the sqrt",
+            ))
+    return out
+
+
+def _rule_r4(rel, tree, aliases, lines):
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
+            out.append(Finding(
+                "R4", rel, node.lineno, _line_text(lines, node.lineno),
+                "raw @ matmul in a precision-pinned module runs at "
+                "bf16-default MXU precision; use utils.precision.hmm",
+            ))
+        elif isinstance(node, ast.Call):
+            dotted = _dotted(node.func, aliases)
+            if dotted in _CONTRACTIONS:
+                if any(kw.arg == "precision" for kw in node.keywords):
+                    continue
+                short = dotted.replace("jax.numpy", "jnp")
+                out.append(Finding(
+                    "R4", rel, node.lineno, _line_text(lines, node.lineno),
+                    f"{short} without precision= in a precision-pinned "
+                    "module; use utils.precision.hmm/heinsum (or pass "
+                    "precision explicitly)",
+                ))
+    return out
+
+
+def _rule_r5(rel, tree, aliases, lines):
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef) or not node.name.endswith("Config"):
+            continue
+        for dec in node.decorator_list:
+            call = dec if isinstance(dec, ast.Call) else None
+            target = dec.func if call is not None else dec
+            dotted = _dotted(target, aliases)
+            if dotted is None or not dotted.endswith("dataclass"):
+                continue
+            if "struct.dataclass" in dotted:
+                continue  # flax.struct.dataclass is frozen by construction
+            frozen = call is not None and any(
+                kw.arg == "frozen"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+                for kw in call.keywords
+            )
+            if not frozen:
+                out.append(Finding(
+                    "R5", rel, node.lineno, _line_text(lines, node.lineno),
+                    f"config dataclass {node.name} must be frozen=True to "
+                    "be hashable as a static jit arg",
+                ))
+    return out
+
+
+def _rule_r6(rel, tree, aliases, lines):
+    first_import = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            tops = [a.name.split(".")[0] for a in node.names]
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            tops = [node.module.split(".")[0]]
+        else:
+            continue
+        if any(t in _JAX_ADJACENT for t in tops):
+            if first_import is None or node.lineno < first_import:
+                first_import = node.lineno
+    if first_import is None:
+        return []
+    if _force_cpu_guard_line(tree, aliases) is not None:
+        return []
+    return [Finding(
+        "R6", rel, first_import, _line_text(lines, first_import),
+        "ad-hoc script imports jax-adjacent modules without the force-CPU "
+        'guard; add jax.config.update("jax_platforms", "cpu") before first '
+        "device use (or an inline suppression if the script is sanctioned "
+        "to touch the chip)",
+    )]
+
+
+# --------------------------------------------------------------------------
+# R3: package-wide call graph
+
+class _Module:
+    def __init__(self, rel: str, tree: ast.AST, lines: list[str]):
+        self.rel = rel
+        self.tree = tree
+        self.lines = lines
+        self.aliases = _alias_map(tree)
+        # "esac_tpu/geometry/pnp.py" -> "esac_tpu.geometry.pnp"
+        self.dotted = rel[:-3].replace("/", ".")
+        if self.dotted.endswith(".__init__"):
+            self.dotted = self.dotted[: -len(".__init__")]
+        self.functions: dict[str, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.setdefault(node.name, node)
+
+
+def _resolve_function(dotted: str, modules: dict[str, "_Module"], _depth=0):
+    """Dotted callable name -> (module, funcname) inside the package.
+
+    Follows one level of package-``__init__`` re-exports
+    (``from esac_tpu.ransac import dsac_infer``)."""
+    if not dotted.startswith("esac_tpu.") or _depth > 4:
+        return None
+    mod_path, _, func = dotted.rpartition(".")
+    m = modules.get(mod_path)
+    if m is None:
+        return None
+    if func in m.functions:
+        return (mod_path, func)
+    target = m.aliases.get(func)
+    if target is not None and target != dotted:
+        return _resolve_function(target, modules, _depth + 1)
+    return None
+
+
+def _callees(
+    mod: _Module, body: ast.AST, modules: dict[str, "_Module"]
+) -> set[tuple[str, str]]:
+    out = set()
+    for node in ast.walk(body):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func, mod.aliases)
+        if dotted is None:
+            continue
+        if "." not in dotted and dotted in mod.functions:
+            out.add((mod.dotted, dotted))
+            continue
+        resolved = _resolve_function(dotted, modules)
+        if resolved:
+            out.add(resolved)
+    return out
+
+
+def _r3_roots(modules: dict[str, _Module]) -> set[tuple[str, str]]:
+    roots: set[tuple[str, str]] = set()
+    for mod in modules.values():
+        for name, fn in mod.functions.items():
+            for dec in fn.decorator_list:
+                for sub in ast.walk(dec):
+                    d = _dotted(sub, mod.aliases)
+                    if d in _JIT_WRAPPERS:
+                        roots.add((mod.dotted, name))
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _dotted(node.func, mod.aliases) not in _JIT_WRAPPERS:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                names = []
+                if isinstance(arg, (ast.Name, ast.Attribute)):
+                    names.append(arg)
+                elif isinstance(arg, ast.Lambda):
+                    names.extend(
+                        n for n in ast.walk(arg.body)
+                        if isinstance(n, (ast.Name, ast.Attribute))
+                    )
+                for n in names:
+                    d = _dotted(n, mod.aliases)
+                    if d is None:
+                        continue
+                    if "." not in d and d in mod.functions:
+                        roots.add((mod.dotted, d))
+                    else:
+                        resolved = _resolve_function(d, modules)
+                        if resolved:
+                            roots.add(resolved)
+    return roots
+
+
+def _rule_r3(modules: dict[str, _Module]):
+    roots = _r3_roots(modules)
+    reachable: set[tuple[str, str]] = set()
+    frontier = list(roots)
+    while frontier:
+        key = frontier.pop()
+        if key in reachable:
+            continue
+        reachable.add(key)
+        mod = modules.get(key[0])
+        if mod is None:
+            continue
+        fn = mod.functions.get(key[1])
+        if fn is None:
+            continue
+        frontier.extend(_callees(mod, fn, modules))
+
+    out = []
+    for mod_dotted, func in sorted(reachable):
+        mod = modules[mod_dotted]
+        fn = mod.functions[func]
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func, mod.aliases)
+            if dotted is None:
+                continue
+            short = dotted.replace("jax.numpy", "jnp")
+            if dotted == "jax.lax.while_loop":
+                out.append(Finding(
+                    "R3", mod.rel, node.lineno,
+                    _line_text(mod.lines, node.lineno),
+                    f"{short} inside {func}() which is reachable from a "
+                    "jit/vmap hot path; its trip count is data-dependent — "
+                    "use a fixed-length jax.lax.scan",
+                ))
+            elif (
+                dotted.startswith(("jax.numpy.linalg.", "jax.scipy.linalg.",
+                                   "jax.lax.linalg."))
+                and dotted.rpartition(".")[2] in _SCALAR_LINALG
+            ):
+                out.append(Finding(
+                    "R3", mod.rel, node.lineno,
+                    _line_text(mod.lines, node.lineno),
+                    f"{short} inside {func}() which is reachable from a "
+                    "jit/vmap hot path; lowers to scalar loops on TPU — "
+                    "use the unrolled/triad patterns in geometry/pnp.py",
+                ))
+    return out
+
+
+# --------------------------------------------------------------------------
+# driver
+
+def run_python_rules(root, files=None) -> list[Finding]:
+    root = pathlib.Path(root)
+    findings: list[Finding] = []
+    r3_modules: dict[str, _Module] = {}
+    suppressions: dict[str, tuple[dict, set]] = {}
+
+    for rel in iter_python_files(root, files):
+        try:
+            source = (root / rel).read_text()
+            tree = ast.parse(source, filename=rel)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            findings.append(Finding(
+                "R0", rel, getattr(e, "lineno", 0) or 0, "",
+                f"unparsable python: {e}",
+            ))
+            continue
+        lines = source.splitlines()
+        aliases = _alias_map(tree)
+        suppressions[rel] = parse_suppressions(source)
+
+        if _r1_scope(rel):
+            findings += _rule_r1(rel, tree, aliases, lines)
+        if _r2_scope(rel):
+            findings += _rule_r2(rel, tree, aliases, lines)
+        if _r4_scope(rel):
+            findings += _rule_r4(rel, tree, aliases, lines)
+        if _r5_scope(rel):
+            findings += _rule_r5(rel, tree, aliases, lines)
+        if _r6_scope(rel):
+            findings += _rule_r6(rel, tree, aliases, lines)
+        if _r3_scope(rel):
+            m = _Module(rel, tree, lines)
+            r3_modules[m.dotted] = m
+
+    if r3_modules:
+        # Every R3 path was parsed in the loop above, so its suppressions
+        # are already in the table.
+        findings += _rule_r3(r3_modules)
+
+    out = []
+    for f in findings:
+        per_line, per_file = suppressions.get(f.path, ({}, set()))
+        if not is_suppressed(f.rule, f.line, per_line, per_file):
+            out.append(f)
+    return out

@@ -1,0 +1,182 @@
+"""graft-lint CLI: ``python -m esac_tpu.lint``.
+
+Exit codes follow the driver contract: 0 clean, 1 findings, 2 internal
+error.
+
+Modes
+-----
+- default           : layer 1 over the full tree + layer 2 (jaxpr audit)
+- ``--changed``     : layer 1 over git-modified/untracked files only; the
+                      jaxpr audit runs only when a traced package file
+                      changed (fast pre-commit mode)
+- ``PATHS…``        : layer 1 over the given files/dirs; the jaxpr audit
+                      runs only when they include package (esac_tpu/) files
+- ``--no-jaxpr``    : skip layer 2 anywhere
+- ``--write-baseline``: regenerate lint_baseline.json from current
+                      layer-1 findings (review the diff before committing!)
+
+The jaxpr audit itself forces the CPU backend before any device use — the
+lint must never become the second stuck TPU client it lints against
+(CLAUDE.md environment hazards).
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import subprocess
+import sys
+
+from esac_tpu.lint import run_layer1
+from esac_tpu.lint.findings import RULES
+from esac_tpu.lint.suppress import Baseline
+
+BASELINE_NAME = "lint_baseline.json"
+
+
+def find_repo_root(start: pathlib.Path | None = None) -> pathlib.Path:
+    p = (start or pathlib.Path.cwd()).resolve()
+    for cand in (p, *p.parents):
+        if (cand / "pyproject.toml").exists() or (cand / ".git").exists():
+            return cand
+    return p
+
+
+def _changed_files(root: pathlib.Path) -> list[str]:
+    """Tracked-modified + staged + untracked paths, repo-relative."""
+    out: set[str] = set()
+    for args in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        res = subprocess.run(
+            args, cwd=root, capture_output=True, text=True, check=False
+        )
+        if res.returncode == 0:
+            out.update(line for line in res.stdout.splitlines() if line)
+    return sorted(out)
+
+
+def _expand_paths(root: pathlib.Path, paths: list[str]) -> list[str]:
+    files: list[str] = []
+    for p in paths:
+        full = (root / p) if not pathlib.Path(p).is_absolute() else pathlib.Path(p)
+        if full.is_dir():
+            files.extend(
+                f.relative_to(root).as_posix()
+                for f in sorted(full.rglob("*"))
+                if f.suffix in (".py", ".sh")
+            )
+        else:
+            files.append(full.resolve().relative_to(root.resolve()).as_posix())
+    return files
+
+
+def _audit_needed(files: list[str] | None) -> bool:
+    # Any package file can shift what the registry entries trace — not least
+    # esac_tpu/utils/{precision,num}.py, whose invariants ARE the audit.
+    if files is None:
+        return True
+    return any(
+        f.startswith("esac_tpu/") and f.endswith(".py") for f in files
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m esac_tpu.lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("paths", nargs="*",
+                        help="files/dirs to lint (default: full tree)")
+    parser.add_argument("--changed", action="store_true",
+                        help="lint only git-modified/untracked files")
+    parser.add_argument("--no-jaxpr", action="store_true",
+                        help="skip the layer-2 jaxpr audit")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: auto-detect)")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline path (default: <root>/{BASELINE_NAME})")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="regenerate the baseline from current findings")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, (summary, rationale) in RULES.items():
+            print(f"{rule}: {summary}\n    ({rationale})")
+        return 0
+
+    root = pathlib.Path(args.root).resolve() if args.root else find_repo_root()
+    baseline_path = (
+        pathlib.Path(args.baseline) if args.baseline else root / BASELINE_NAME
+    )
+
+    # Everything up to the verdict is "internal": a crash anywhere here
+    # (unreadable path, malformed baseline JSON) must exit 2, never be
+    # mistaken for findings (exit 1).
+    try:
+        files: list[str] | None = None
+        if args.changed:
+            files = _changed_files(root)
+            if not files:
+                print("graft-lint: no changed files")
+                return 0
+        elif args.paths:
+            files = _expand_paths(root, args.paths)
+
+        findings = run_layer1(root, files=files)
+
+        if args.write_baseline:
+            if files is not None:
+                # A scoped run sees only a slice of the tree; writing it out
+                # would silently drop every entry for the unscanned files.
+                print(
+                    "graft-lint: --write-baseline requires a full-tree run "
+                    "(drop --changed / PATHS)",
+                    file=sys.stderr,
+                )
+                return 2
+            Baseline.from_findings(findings).write(baseline_path)
+            print(
+                f"graft-lint: wrote {len(findings)} entries to {baseline_path}"
+            )
+            return 0
+
+        baseline = Baseline.load(baseline_path)
+        findings, stale = baseline.apply(findings)
+    except Exception as e:  # internal error, not a finding
+        print(f"graft-lint: internal error in layer 1: {e!r}", file=sys.stderr)
+        return 2
+    # In scoped runs most baseline entries legitimately match nothing
+    # (their files weren't linted) — only report staleness on full runs.
+    if files is None:
+        for e in stale:
+            print(
+                f"graft-lint: stale baseline entry ({e.rule} {e.path}): "
+                "expired or no longer matches — remove it from "
+                f"{baseline_path.name}"
+            )
+
+    for f in findings:
+        print(f.format())
+
+    audit_failures = []
+    if not args.no_jaxpr and _audit_needed(files):
+        try:
+            from esac_tpu.lint.jaxpr_audit import run_audit
+
+            audit_failures = run_audit()
+        except Exception as e:
+            print(f"graft-lint: internal error in jaxpr audit: {e!r}",
+                  file=sys.stderr)
+            return 2
+        for f in audit_failures:
+            print(f.format())
+
+    n = len(findings) + len(audit_failures)
+    scope = "changed files" if args.changed else ("paths" if args.paths else "tree")
+    print(f"graft-lint: {n} finding(s) over {scope}"
+          + ("" if args.no_jaxpr or not _audit_needed(files)
+             else " (incl. jaxpr audit)"))
+    return 1 if n else 0

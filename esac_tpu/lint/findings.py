@@ -1,0 +1,93 @@
+"""Finding record + the rule catalog (ids, one-liners, rationale pointers).
+
+Pure stdlib on purpose: layer 1 must be runnable (and fast) without
+initializing anything jax-adjacent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint hit.  ``text`` is the stripped offending source line — the
+    line-number-independent identity used for baseline matching, so findings
+    survive unrelated edits above them."""
+
+    rule: str      # "R1".."R7" or "J1".."J3" (jaxpr auditor)
+    path: str      # repo-relative, forward slashes
+    line: int      # 1-based; 0 for whole-file findings
+    text: str      # stripped source line ("" for whole-file findings)
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+# rule id -> (summary, rationale pointer).  LINT.md carries the full prose.
+RULES = {
+    "R1": (
+        "module-level jnp/jax array constant (import-time backend init)",
+        "CLAUDE.md environment hazards: NEVER create module-level jnp.array "
+        "constants — they initialize the device backend at import time",
+    ),
+    "R2": (
+        "raw jnp.linalg.norm / bare jnp.sqrt in differentiated geometry",
+        "CLAUDE.md code conventions: where does not stop NaNs from the "
+        "untaken branch's VJP; use utils.num.safe_norm / safe_sqrt (eps "
+        "inside the sqrt) for anything differentiated",
+    ),
+    "R3": (
+        "scalar-looping linalg (svd/solve/inv/...) reachable from a "
+        "jit/vmap hot path",
+        "CLAUDE.md code conventions / DESIGN.md: jnp.linalg.svd/solve lower "
+        "to scalar loops on TPU — use triad alignment / unrolled "
+        "elimination as in geometry/pnp.py",
+    ),
+    "R4": (
+        "unpinned matmul/einsum/dot in a precision-pinned module",
+        "CLAUDE.md code conventions: pin 3x3/6x6 algebra with "
+        "utils.precision.hmm/heinsum — bf16-default MXU corrupts rotation "
+        "math",
+    ),
+    "R5": (
+        "config dataclass not frozen=True",
+        "CLAUDE.md code conventions: configs are frozen dataclasses used as "
+        "static jit args; an unfrozen config is unhashable under jit and "
+        "invites silent retraces",
+    ),
+    "R6": (
+        "ad-hoc script imports jax-adjacent modules without the force-CPU "
+        "guard",
+        "CLAUDE.md environment hazards: a bare interpreter that touches "
+        "jax.devices() while the relay is unhealthy becomes a second stuck "
+        "process; force CPU with jax.config.update('jax_platforms', 'cpu')",
+    ),
+    "R7": (
+        "shell script timeout/kill around a python invocation "
+        "(relay-wedge hazard)",
+        "CLAUDE.md environment hazards: the TPU relay wedges permanently if "
+        "a jax process holding/awaiting the device is killed; wrap "
+        "chip-touching scripts the way bench.py does (detached child, "
+        "poll, never kill)",
+    ),
+    # Layer-2 (jaxpr auditor) finding ids, reported with path = the
+    # registry entry name:
+    "J1": (
+        "disallowed primitive in a registered entry point's jaxpr",
+        "CLAUDE.md code conventions: no svd/lu/eig/while-with-dynamic-trip "
+        "in compiled hot paths",
+    ),
+    "J2": (
+        "non-static shape in a registered entry point's jaxpr",
+        "CLAUDE.md code conventions: static shapes and fixed iteration "
+        "counts everywhere under jit",
+    ),
+    "J3": (
+        "dot_general without pinned HIGHEST/f32 precision in a "
+        "precision-pinned call graph",
+        "CLAUDE.md code conventions: bf16-default MXU corrupts rotation "
+        "math; geometry-core contractions go through hmm/heinsum",
+    ),
+}

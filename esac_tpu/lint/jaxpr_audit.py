@@ -1,0 +1,149 @@
+"""Layer 2: audit the jaxprs of registered entry points on the CPU backend.
+
+AST rules see what the source *says*; this sees what XLA will actually be
+asked to *compile*.  Each registry entry is traced with ``jax.make_jaxpr``
+(abstract evaluation only — nothing executes, nothing compiles) and the
+resulting jaxpr is walked recursively (pjit / scan / cond / shard_map /
+custom_vjp sub-jaxprs included) for three checks:
+
+- **J1** — disallowed primitives: decompositions that lower to scalar
+  loops on TPU (svd/lu/eig/tridiagonal/triangular_solve/linear_solve) and
+  ``while`` (a data-dependent trip count; every loop in this codebase must
+  be a fixed-length ``scan``).
+- **J2** — non-static shapes: every dimension of every aval must be a
+  concrete int (CLAUDE.md: static shapes everywhere under jit).
+- **J3** — ``dot_general`` precision in ``pinned=True`` call graphs: the
+  precision pair must be HIGHEST and the output dtype float32
+  (utils.precision.hmm/heinsum discipline; bf16-default MXU corrupts
+  rotation math).
+
+The audit forces the CPU backend (and an 8-device virtual mesh for the
+sharded entry) BEFORE first device use — per CLAUDE.md, an ad-hoc process
+touching ``jax.devices()`` while the relay is unhealthy becomes a second
+permanently-stuck client.  The "pallas" scoring impl is deliberately not
+registered: off-TPU it traces through interpret mode whose jaxpr is not
+the shipped kernel; its parity is covered by tests/test_pallas_scoring.py.
+"""
+
+from __future__ import annotations
+
+from esac_tpu.lint.findings import Finding
+
+# Primitives that lower to scalar loops on TPU, or have data-dependent trip
+# counts.  Names are jaxpr primitive names.
+DISALLOWED_PRIMITIVES = {
+    "svd", "lu", "eig", "eigh", "schur", "tridiagonal", "tridiagonal_solve",
+    "triangular_solve", "custom_linear_solve", "linear_solve", "while",
+}
+
+
+def _force_cpu() -> None:
+    import jax
+
+    # Env-var JAX_PLATFORMS is overridden by the container sitecustomize;
+    # the config update after import is the one that sticks (CLAUDE.md).
+    jax.config.update("jax_platforms", "cpu")
+    # 8 virtual devices so the sharded registry entry can trace.
+    from esac_tpu.parallel.mesh import ensure_virtual_devices
+
+    ensure_virtual_devices(8)
+
+
+def _is_jaxpr(obj) -> bool:
+    return hasattr(obj, "eqns") and hasattr(obj, "invars")
+
+
+def _sub_jaxprs(params: dict):
+    for v in params.values():
+        vals = v if isinstance(v, (list, tuple)) else (v,)
+        for item in vals:
+            if _is_jaxpr(item):
+                yield item
+            elif hasattr(item, "jaxpr") and _is_jaxpr(item.jaxpr):
+                yield item.jaxpr
+
+
+def iter_eqns(jaxpr):
+    """Depth-first over all equations, sub-jaxprs included."""
+    stack = [jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr]
+    seen: set[int] = set()
+    while stack:
+        j = stack.pop()
+        if id(j) in seen:
+            continue
+        seen.add(id(j))
+        for eqn in j.eqns:
+            yield eqn
+            stack.extend(_sub_jaxprs(eqn.params))
+
+
+def _precision_is_highest(precision) -> bool:
+    import jax
+
+    hi = jax.lax.Precision.HIGHEST
+    if precision == hi:
+        return True
+    return (
+        isinstance(precision, (tuple, list))
+        and len(precision) == 2
+        and all(p == hi for p in precision)
+    )
+
+
+def audit_jaxpr(name: str, closed_jaxpr, pinned: bool) -> list[Finding]:
+    """All J-findings for one entry's jaxpr.  ``name`` doubles as the
+    finding path so reports read ``<entry>:0: J1 …``."""
+    import numpy as np
+
+    findings = []
+    seen_texts: set[tuple[str, str]] = set()
+
+    def add(rule: str, text: str, message: str) -> None:
+        # One report per (rule, identity): a primitive repeated through a
+        # scan body would otherwise flood the output.
+        if (rule, text) in seen_texts:
+            return
+        seen_texts.add((rule, text))
+        findings.append(Finding(rule, name, 0, text, message))
+
+    for eqn in iter_eqns(closed_jaxpr):
+        prim = eqn.primitive.name
+        if prim in DISALLOWED_PRIMITIVES:
+            add("J1", prim,
+                f"disallowed primitive '{prim}' in traced entry point "
+                "(scalar-loop lowering or data-dependent trip count on TPU)")
+        for var in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(var, "aval", None)
+            shape = getattr(aval, "shape", ())
+            for d in shape:
+                if not isinstance(d, (int, np.integer)):
+                    add("J2", f"{prim}:{shape}",
+                        f"non-static dimension {d!r} in '{prim}' "
+                        "(static shapes required under jit)")
+        if pinned and prim == "dot_general":
+            precision = eqn.params.get("precision")
+            out_dtype = eqn.outvars[0].aval.dtype
+            if not _precision_is_highest(precision):
+                add("J3", f"dot_general:precision={precision}",
+                    f"dot_general with precision={precision} in pinned "
+                    "call graph; route through utils.precision.hmm/heinsum "
+                    "(Precision.HIGHEST)")
+            elif str(out_dtype) != "float32":
+                add("J3", f"dot_general:dtype={out_dtype}",
+                    f"dot_general output dtype {out_dtype} in pinned call "
+                    "graph; rotation algebra must stay f32")
+    return findings
+
+
+def run_audit(entries=None) -> list[Finding]:
+    """Trace every registry entry and return all findings."""
+    _force_cpu()
+    from esac_tpu.lint.registry import ENTRIES
+
+    findings: list[Finding] = []
+    for entry in entries if entries is not None else ENTRIES:
+        closed = entry.build()
+        if closed is None:
+            continue  # entry not traceable in this process (e.g. no mesh)
+        findings += audit_jaxpr(entry.name, closed, entry.pinned)
+    return findings

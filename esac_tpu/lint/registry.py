@@ -1,0 +1,230 @@
+"""Layer-2 registry: the entry points the jaxpr auditor traces.
+
+Each entry names a real compiled surface of the system — the RANSAC kernel,
+the scoring impls, the PnP solve, the sharded train step — and a builder
+that returns its ClosedJaxpr, traced at deliberately tiny static shapes
+(tracing is abstract evaluation; shapes only change trace time, not what
+primitives appear).  ``pinned=True`` marks call graphs whose every
+``dot_general`` must run at HIGHEST precision / f32 output (the CLAUDE.md
+rotation-math invariant); the CNN-bearing sharded step is audited for
+primitives and shapes only, since bf16 conv/dense compute is the *correct*
+policy there (models/expert.py).
+
+Everything imports jax lazily and the auditor forces the CPU backend before
+any builder runs — the lint must never itself become a TPU relay client.
+
+Gradient traces are used wherever the backward pass is the risk surface
+(autodiff-through-IRLS is where NaN/precision bugs actually bite); the
+sharded entry is traced forward-only to keep the audit cheap.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections.abc import Callable
+
+
+@dataclasses.dataclass(frozen=True)
+class Entry:
+    name: str
+    pinned: bool          # enforce HIGHEST/f32 on every dot_general
+    build: Callable       # () -> jax.core.ClosedJaxpr | None (None = skip)
+    note: str = ""
+
+
+def _geom_inputs():
+    import jax
+    import jax.numpy as jnp
+
+    N = 16
+    k = jax.random.key(0)
+    coords = jax.random.uniform(k, (N, 3), minval=-1.0, maxval=1.0)
+    pixels = jax.random.uniform(jax.random.key(1), (N, 2), maxval=64.0)
+    f = jnp.float32(60.0)
+    c = jnp.asarray([32.0, 24.0])
+    return coords, pixels, f, c
+
+
+def _build_pnp_minimal_grad():
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.geometry.pnp import solve_pnp_minimal
+
+    coords, pixels, f, c = _geom_inputs()
+    X4, x4 = coords[:4], pixels[:4]
+
+    def loss(X4):
+        rvec, tvec = solve_pnp_minimal(X4, x4, f, c, polish_iters=1)
+        return jnp.sum(rvec) + jnp.sum(tvec)
+
+    return jax.make_jaxpr(jax.grad(loss))(X4)
+
+
+def _build_refine_grad():
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.ransac.refine import refine_soft_inliers
+
+    coords, pixels, f, c = _geom_inputs()
+    rvec = jnp.asarray([0.1, -0.05, 0.02])
+    tvec = jnp.asarray([0.0, 0.0, 2.0])
+
+    def loss(coords):
+        rv, tv = refine_soft_inliers(
+            rvec, tvec, coords, pixels, f, c, tau=10.0, beta=0.5, iters=2
+        )
+        return jnp.sum(rv) + jnp.sum(tv)
+
+    return jax.make_jaxpr(jax.grad(loss))(coords)
+
+
+def _build_dsac_infer():
+    import jax
+
+    from esac_tpu.ransac.config import RansacConfig
+    from esac_tpu.ransac.kernel import dsac_infer
+
+    coords, pixels, f, c = _geom_inputs()
+    cfg = RansacConfig(n_hyps=8, refine_iters=2, polish_iters=1)
+    key = jax.random.key(2)
+    return jax.make_jaxpr(
+        lambda k, co: dsac_infer(k, co, pixels, f, c, cfg)
+    )(key, coords)
+
+
+def _build_dsac_train_grad():
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.geometry.rotations import rodrigues
+    from esac_tpu.ransac.config import RansacConfig
+    from esac_tpu.ransac.kernel import dsac_train_loss
+
+    coords, pixels, f, c = _geom_inputs()
+    cfg = RansacConfig(n_hyps=4, train_refine_iters=1, polish_iters=1)
+    R_gt = rodrigues(jnp.asarray([0.1, 0.0, 0.0]))
+    t_gt = jnp.asarray([0.0, 0.0, 2.0])
+    key = jax.random.key(3)
+
+    def loss(coords):
+        val, _ = dsac_train_loss(key, coords, pixels, f, c, R_gt, t_gt, cfg)
+        return val
+
+    return jax.make_jaxpr(jax.grad(loss))(coords)
+
+
+def _build_scoring(impl: str):
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from esac_tpu.ransac.config import RansacConfig
+        from esac_tpu.ransac.kernel import _score_hypotheses
+
+        coords, pixels, f, c = _geom_inputs()
+        cfg = RansacConfig(n_hyps=4, scoring_impl=impl)
+        rvecs = jnp.tile(jnp.asarray([0.1, -0.05, 0.02]), (4, 1))
+        tvecs = jnp.tile(jnp.asarray([0.0, 0.0, 2.0]), (4, 1))
+        key = jax.random.key(4)
+
+        def loss(coords):
+            return jnp.sum(
+                _score_hypotheses(key, rvecs, tvecs, coords, pixels, f, c, cfg)
+            )
+
+        return jax.make_jaxpr(jax.grad(loss))(coords)
+
+    return build
+
+
+def _build_esac_train_grad():
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.geometry.rotations import rodrigues
+    from esac_tpu.ransac.config import RansacConfig
+    from esac_tpu.ransac.esac import esac_train_loss
+
+    coords, pixels, f, c = _geom_inputs()
+    M = 2
+    coords_all = jnp.stack([coords, coords + 0.1])
+    cfg = RansacConfig(n_hyps=4, train_refine_iters=1, polish_iters=1)
+    logits = jnp.zeros((M,))
+    R_gt = rodrigues(jnp.asarray([0.1, 0.0, 0.0]))
+    t_gt = jnp.asarray([0.0, 0.0, 2.0])
+    key = jax.random.key(5)
+
+    def loss(coords_all, logits):
+        val, _ = esac_train_loss(
+            key, logits, coords_all, pixels, f, c, R_gt, t_gt, cfg, "dense"
+        )
+        return val
+
+    return jax.make_jaxpr(jax.grad(loss, argnums=(0, 1)))(coords_all, logits)
+
+
+def _build_sharded_train():
+    import jax
+
+    if jax.device_count() < 8:
+        return None  # no virtual mesh in this process; entry is skipped
+
+    import jax.numpy as jnp
+
+    from esac_tpu.data.synthetic import output_pixel_grid
+    from esac_tpu.geometry.rotations import rodrigues
+    from esac_tpu.models.expert import ExpertNet
+    from esac_tpu.models.gating import GatingNet
+    from esac_tpu.parallel.mesh import make_mesh
+    from esac_tpu.parallel.train_sharded import make_sharded_esac_loss
+    from esac_tpu.ransac.config import RansacConfig
+
+    H = W = 16
+    M, B = 4, 2
+    mesh = make_mesh(n_data=2, n_expert=4)
+    expert = ExpertNet(stem_channels=(2, 2, 2), head_channels=2, head_depth=1)
+    gating = GatingNet(num_experts=M, channels=(2,))
+    img = jnp.zeros((1, H, W, 3))
+    e_params = jax.vmap(lambda k: expert.init(k, img))(
+        jax.random.split(jax.random.key(0), M)
+    )
+    g_params = gating.init(jax.random.key(1), img)
+    cfg = RansacConfig(n_hyps=4, train_refine_iters=1, polish_iters=1)
+    pixels = output_pixel_grid(H, W, 8)
+    f = jnp.float32(20.0)
+    c = jnp.asarray([W / 2.0, H / 2.0])
+    loss_fn = make_sharded_esac_loss(
+        mesh, expert, gating, e_params, g_params, pixels, f, c, cfg
+    )
+    images = jnp.zeros((B, H, W, 3))
+    R_gts = jnp.tile(rodrigues(jnp.asarray([0.1, 0.0, 0.0]))[None], (B, 1, 1))
+    t_gts = jnp.tile(jnp.asarray([0.0, 0.0, 2.0]), (B, 1))
+    with mesh:
+        return jax.make_jaxpr(loss_fn)(
+            e_params, g_params, images, R_gts, t_gts, jax.random.key(2)
+        )
+
+
+ENTRIES: tuple[Entry, ...] = (
+    Entry("pnp_minimal_grad", pinned=True, build=_build_pnp_minimal_grad,
+          note="grad of solve_pnp_minimal wrt the 4 scene points"),
+    Entry("refine_soft_inliers_grad", pinned=True, build=_build_refine_grad,
+          note="autodiff-through-IRLS backward (the reference's "
+               "finite-difference replacement)"),
+    Entry("dsac_infer", pinned=True, build=_build_dsac_infer,
+          note="full single-frame hypothesis pipeline"),
+    Entry("dsac_train_loss_grad", pinned=True, build=_build_dsac_train_grad,
+          note="training expectation + backward"),
+    Entry("scoring_errmap_grad", pinned=True, build=_build_scoring("errmap"),
+          note="reference-parity scoring impl"),
+    Entry("scoring_fused_grad", pinned=True, build=_build_scoring("fused"),
+          note="fused XLA broadcast+reduce scoring impl"),
+    Entry("esac_train_loss_dense_grad", pinned=True,
+          build=_build_esac_train_grad,
+          note="multi-expert dense training loss + backward"),
+    Entry("sharded_train_step", pinned=False, build=_build_sharded_train,
+          note="EP+DP shard_map loss, forward only; CNN compute is "
+               "legitimately bf16 so dot precision is not audited here"),
+)

@@ -1,0 +1,93 @@
+"""Layer 1, R7: shell scripts must never timeout/kill a jax python process.
+
+The TPU relay of this environment wedges permanently when a jax process
+holding or awaiting the device is killed (CLAUDE.md environment hazards) —
+and ``timeout`` IS a kill after a countdown.  The sanctioned pattern is
+bench.py's: launch the chip-touching python as a detached child, poll a
+result file, and on deadline ORPHAN the child (never kill, never wait).
+
+Line rules over every ``*.sh`` in the repo:
+
+- ``timeout … python …`` on one line -> finding (the wrapped python gets
+  SIGTERM/SIGKILL on expiry).
+- ``kill`` / ``pkill`` / ``killall`` -> finding, EXCEPT ``kill -0`` (signal
+  0 is a pure liveness probe, delivered nowhere) — the probe loops in
+  tools/chip_recovery.sh and the experiment queues depend on it.
+
+Suppress a sanctioned line with a trailing
+``# graft-lint: disable=R7(reason)`` or file-wide with ``disable-file=``.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+
+from esac_tpu.lint.findings import Finding
+from esac_tpu.lint.suppress import is_suppressed, parse_suppressions
+
+_SKIP_DIRS = {".git", "__pycache__", ".venv", "build", "ckpts", "node_modules"}
+
+_TIMEOUT_PYTHON = re.compile(r"\btimeout\b.*\bpython[0-9.]*\b")
+_KILL = re.compile(r"\b(?P<cmd>kill|pkill|killall)\b(?P<rest>[^|;&]*)")
+_KILL_LIVENESS = re.compile(r"^\s+-0\b")
+
+
+def iter_shell_files(root: pathlib.Path, files=None):
+    if files is not None:
+        for f in files:
+            rel = pathlib.Path(f)
+            if rel.is_absolute():
+                rel = rel.relative_to(root)
+            if rel.suffix == ".sh" and (root / rel).exists():
+                yield rel.as_posix()
+        return
+    for p in sorted(root.rglob("*.sh")):
+        rel = p.relative_to(root)
+        if any(part in _SKIP_DIRS for part in rel.parts):
+            continue
+        yield rel.as_posix()
+
+
+def _scan_line(rel: str, lineno: int, line: str) -> list[Finding]:
+    # Full-line comments carry prose about killing ("never kill…"), not
+    # commands; strip the comment tail before matching, but keep the raw
+    # stripped line as the finding's baseline identity.
+    code = line.split("#", 1)[0]
+    if not code.strip():
+        return []
+    out = []
+    if _TIMEOUT_PYTHON.search(code):
+        out.append(Finding(
+            "R7", rel, lineno, line.strip(),
+            "timeout-wrapped python in a shell script: timeout kills on "
+            "expiry, and killing a jax-on-TPU process wedges the relay "
+            "permanently; use the bench.py detached-child + poll pattern",
+        ))
+    for m in _KILL.finditer(code):
+        if _KILL_LIVENESS.match(m.group("rest")):
+            continue  # kill -0: liveness probe, no signal delivered
+        out.append(Finding(
+            "R7", rel, lineno, line.strip(),
+            f"{m.group('cmd')} in a shell script: killing a jax-on-TPU "
+            "process wedges the relay permanently; orphan instead "
+            "(bench.py pattern), or suppress with a reviewed reason if no "
+            "jax process can be the target",
+        ))
+    return out
+
+
+def run_shell_rules(root, files=None) -> list[Finding]:
+    root = pathlib.Path(root)
+    findings: list[Finding] = []
+    for rel in iter_shell_files(root, files):
+        try:
+            source = (root / rel).read_text()
+        except UnicodeDecodeError:
+            continue
+        per_line, per_file = parse_suppressions(source)
+        for lineno, line in enumerate(source.splitlines(), start=1):
+            for f in _scan_line(rel, lineno, line):
+                if not is_suppressed(f.rule, f.line, per_line, per_file):
+                    findings.append(f)
+    return findings

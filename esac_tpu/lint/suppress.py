@@ -1,0 +1,188 @@
+"""Suppression comments + the committed findings baseline.
+
+Two escape hatches, with different intents:
+
+- **Inline suppression** — ``# graft-lint: disable=R6(reason)`` on the
+  offending line (or ``disable-file=`` near the top of the file for
+  whole-file rules).  For *reviewed, permanent* exceptions: code that is
+  sanctioned to violate a rule by design (e.g. tools/tpu_probe.py exists to
+  touch the chip).  A reason is required by convention; the parser accepts
+  its absence but LINT.md review policy does not.
+- **Baseline** (``lint_baseline.json``) — grandfathers *pre-existing*
+  findings so the lint can land strict without blocking unrelated work.
+  Entries match on (rule, path, stripped source line) — line-number
+  independent — and may carry an ``expires: "YYYY-MM-DD"`` date after which
+  they stop masking.  New code should never add baseline entries; fix or
+  inline-suppress instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import json
+import pathlib
+import re
+
+from esac_tpu.lint.findings import Finding
+
+# "# graft-lint: disable=R1,R2(reason ...)" — comma-separated rule ids, an
+# optional parenthesized reason after each (reasons may not contain ')').
+_DIRECTIVE = re.compile(
+    r"#\s*graft-lint:\s*(?P<kind>disable(?:-file)?)\s*=\s*(?P<rules>[^#]+)"
+)
+_RULE_HEAD = re.compile(r"\s*(?P<rule>[A-Z]\d+)\s*")
+_RULE_SEP = re.compile(r"\s*,")
+
+
+def _parse_rule_list(spec: str) -> set[str]:
+    """Sequential parse of ``R1,R2(reason),R3`` — NOT a global token scan.
+
+    A reason whose closing ')' is missing (it wraps to the next comment
+    line) ends the list: rule ids mentioned inside the prose of a reason
+    must never widen the suppression.
+    """
+    rules: set[str] = set()
+    pos = 0
+    while True:
+        m = _RULE_HEAD.match(spec, pos)
+        if not m:
+            break
+        rules.add(m.group("rule"))
+        pos = m.end()
+        if pos < len(spec) and spec[pos] == "(":
+            close = spec.find(")", pos)
+            if close == -1:
+                break  # reason continues past this line; list ends here
+            pos = close + 1
+        m = _RULE_SEP.match(spec, pos)
+        if not m:
+            break
+        pos = m.end()
+    return rules
+
+# File-level directives must sit in the header, not be buried mid-file.
+_FILE_DIRECTIVE_MAX_LINE = 40
+
+
+def parse_suppressions(source: str) -> tuple[dict[int, set[str]], set[str]]:
+    """-> (line -> rules suppressed on that line, rules suppressed file-wide).
+
+    Works for Python and shell alike: both comment with ``#``.
+    """
+    per_line: dict[int, set[str]] = {}
+    per_file: set[str] = set()
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        m = _DIRECTIVE.search(line)
+        if not m:
+            continue
+        rules = _parse_rule_list(m.group("rules"))
+        if m.group("kind") == "disable-file":
+            if lineno <= _FILE_DIRECTIVE_MAX_LINE:
+                per_file |= rules
+        else:
+            per_line.setdefault(lineno, set()).update(rules)
+    return per_line, per_file
+
+
+def is_suppressed(
+    rule: str,
+    lineno: int,
+    per_line: dict[int, set[str]],
+    per_file: set[str],
+) -> bool:
+    return rule in per_file or rule in per_line.get(lineno, set())
+
+
+def filter_suppressed(findings, sources: dict[str, str]):
+    """Drop findings whose file carries a matching inline directive.
+
+    ``sources`` maps repo-relative path -> file text.  Rule modules normally
+    check suppressions themselves while they still hold the AST; this is
+    the generic fallback for callers composing rule outputs.
+    """
+    out = []
+    cache: dict[str, tuple[dict[int, set[str]], set[str]]] = {}
+    for f in findings:
+        src = sources.get(f.path)
+        if src is None:
+            out.append(f)
+            continue
+        if f.path not in cache:
+            cache[f.path] = parse_suppressions(src)
+        per_line, per_file = cache[f.path]
+        if not is_suppressed(f.rule, f.line, per_line, per_file):
+            out.append(f)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class BaselineEntry:
+    rule: str
+    path: str
+    text: str
+    expires: str | None = None  # "YYYY-MM-DD"; None = never
+
+    def key(self) -> tuple[str, str, str]:
+        return (self.rule, self.path, self.text)
+
+    def expired(self, today: datetime.date) -> bool:
+        if self.expires is None:
+            return False
+        return datetime.date.fromisoformat(self.expires) < today
+
+
+class Baseline:
+    """The committed grandfather list (lint_baseline.json)."""
+
+    def __init__(self, entries: list[BaselineEntry]):
+        self.entries = entries
+
+    @classmethod
+    def load(cls, path: pathlib.Path) -> "Baseline":
+        if not path.exists():
+            return cls([])
+        data = json.loads(path.read_text())
+        return cls([BaselineEntry(**e) for e in data.get("entries", [])])
+
+    @classmethod
+    def from_findings(cls, findings) -> "Baseline":
+        return cls([
+            BaselineEntry(rule=f.rule, path=f.path, text=f.text)
+            for f in findings
+        ])
+
+    def write(self, path: pathlib.Path) -> None:
+        data = {
+            "comment": "graft-lint grandfathered findings; see LINT.md. "
+                       "Matching is (rule, path, stripped source line), "
+                       "line-number independent.  Do not add entries for "
+                       "new code.",
+            "entries": [dataclasses.asdict(e) for e in self.entries],
+        }
+        path.write_text(json.dumps(data, indent=2) + "\n")
+
+    def apply(
+        self, findings, today: datetime.date | None = None
+    ) -> tuple[list[Finding], list[BaselineEntry]]:
+        """-> (findings not masked by the baseline, stale entries).
+
+        A stale entry matched nothing (the violation was fixed — the entry
+        should be deleted) or has expired (it masks nothing anymore and its
+        finding resurfaces).
+        """
+        today = today or datetime.date.today()
+        live = {e.key(): e for e in self.entries if not e.expired(today)}
+        matched: set[tuple[str, str, str]] = set()
+        out = []
+        for f in findings:
+            key = (f.rule, f.path, f.text)
+            if key in live:
+                matched.add(key)
+            else:
+                out.append(f)
+        stale = [
+            e for e in self.entries
+            if e.expired(today) or e.key() not in matched
+        ]
+        return out, stale

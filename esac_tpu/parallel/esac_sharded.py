@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from esac_tpu.parallel.mesh import shard_map
 from esac_tpu.ransac.config import RansacConfig
 from esac_tpu.ransac.esac import _per_expert_hypotheses
 from esac_tpu.ransac.kernel import _split_score_key
@@ -86,7 +87,7 @@ def esac_infer_sharded(
     m_local = M // n_exp_shards
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(), P("expert"), P()),
         out_specs=(P(), P(), P(), P()),
@@ -203,7 +204,7 @@ def esac_infer_routed(
     e_specs = jax.tree.map(lambda _: P("expert"), e_stack)
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(), e_specs, P("expert"), P(), P()),
         out_specs=(P(), P(), P(), P(), P()),

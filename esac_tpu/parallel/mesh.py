@@ -13,6 +13,38 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# shard_map moved from jax.experimental to the jax namespace upstream; this
+# container's jax (0.4.x) only has the experimental spelling.  Every
+# shard_map in the package goes through this alias so the code works on
+# both (and the graft-lint jaxpr auditor can trace the sharded train step).
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
+
+
+def ensure_virtual_devices(n: int) -> None:
+    """Best-effort: give this CPU process ``n`` virtual devices.
+
+    Must run before the backend initializes (first ``jax.devices()`` /
+    array op); afterwards it is a silent no-op and the caller's
+    ``device_count`` check fires instead.  Newer jax spells this
+    ``jax_num_cpu_devices``; this container's 0.4.x only honors the
+    ``XLA_FLAGS`` host-platform flag (the same one tests/conftest.py sets).
+    """
+    import os
+
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+        return
+    except Exception:
+        pass
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
 
 def make_mesh(
     n_data: int = 1,

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from esac_tpu.parallel.mesh import shard_map
 from esac_tpu.ransac.config import RansacConfig
 from esac_tpu.ransac.esac import (
     _expected_losses_per_expert, esac_train_loss,
@@ -93,7 +94,7 @@ def make_sharded_esac_loss(
             jax.random.fold_in(key, jax.lax.axis_index("data")), b_local
         )
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=in_specs, out_specs=P())
+    @partial(shard_map, mesh=mesh, in_specs=in_specs, out_specs=P())
     def sharded_loss(e_p_local, g_p, images_local, R_gt_local, t_gt_local, key):
         b_local = images_local.shape[0]
         logits = gating_net.apply(g_p, images_local)  # (b_local, M_total)
@@ -116,7 +117,7 @@ def make_sharded_esac_loss(
         )(keys, logits, coords_all, R_gt_local, t_gt_local)
         return jax.lax.pmean(jnp.mean(losses), ("data", "expert"))
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=in_specs, out_specs=P())
+    @partial(shard_map, mesh=mesh, in_specs=in_specs, out_specs=P())
     def sharded_routed_loss(e_p_local, g_p, images_local, R_gt_local,
                             t_gt_local, key):
         b_local = images_local.shape[0]

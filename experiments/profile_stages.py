@@ -15,6 +15,10 @@ detached on TPU (CLAUDE.md wedge hazards).
 
 from __future__ import annotations
 
+# graft-lint: disable-file=R6(dual-backend by design: meaningful numbers
+# need the real chip, where it is launched detached per the wedge protocol;
+# a force-CPU guard would pin it to the smoke-test backend)
+
 import json
 import pathlib
 import time

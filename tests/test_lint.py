@@ -1,0 +1,585 @@
+"""graft-lint tests: golden trigger + near-miss fixtures per rule R1-R7,
+suppression/baseline machinery, the jaxpr auditor, CLI exit codes, and the
+tier-1 gate that the committed tree is clean modulo lint_baseline.json.
+
+Fixture sources are written into tmp_path trees that mimic the repo layout
+(rule scopes are path-based), never into the repo itself.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+from esac_tpu.lint import run_layer1
+from esac_tpu.lint.cli import main as lint_main
+from esac_tpu.lint.suppress import Baseline, BaselineEntry, parse_suppressions
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _write(root: pathlib.Path, rel: str, text: str) -> str:
+    p = root / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(text))
+    return rel
+
+
+def _rules(findings) -> list[str]:
+    return sorted(f.rule for f in findings)
+
+
+# --------------------------------------------------------------------------
+# R1: module-level jnp constants
+
+def test_r1_trigger_and_near_miss(tmp_path):
+    _write(tmp_path, "esac_tpu/constants.py", """\
+        import jax.numpy as jnp
+        GRID = jnp.zeros((3, 3))
+        """)
+    _write(tmp_path, "esac_tpu/near_miss.py", """\
+        import numpy as np
+        import jax.numpy as jnp
+
+        NP_GRID = np.zeros((3, 3))          # numpy at import time is fine
+
+        def inside():
+            return jnp.zeros((3, 3))        # function scope is fine
+        """)
+    findings = run_layer1(tmp_path)
+    assert _rules(findings) == ["R1"]
+    assert findings[0].path == "esac_tpu/constants.py"
+
+
+def test_r1_guarded_script_is_exempt(tmp_path):
+    # The generalization.py pattern: a module-level script that forces CPU
+    # on line 1 may build arrays at import time — they land on CPU.
+    _write(tmp_path, "experiments/sweep.py", """\
+        import jax; jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        GRID = jnp.zeros((3, 3))
+        """)
+    assert run_layer1(tmp_path) == []
+
+
+def test_r1_guard_inside_function_does_not_exempt(tmp_path):
+    # A force-CPU call buried in main() never runs at import time, so it
+    # cannot make a module-level array constant safe — but it DOES satisfy
+    # R6 (the script forces CPU before first device use when run).
+    _write(tmp_path, "tools/late_guard.py", """\
+        import jax
+        import jax.numpy as jnp
+
+        X = jnp.zeros(3)
+
+        def main():
+            jax.config.update("jax_platforms", "cpu")
+            print(jax.devices())
+        """)
+    assert _rules(run_layer1(tmp_path)) == ["R1"]
+
+
+def test_r1_function_defaults_run_at_import(tmp_path):
+    _write(tmp_path, "esac_tpu/defaults.py", """\
+        import jax.numpy as jnp
+
+        def f(x=jnp.eye(3)):
+            return x
+        """)
+    assert _rules(run_layer1(tmp_path)) == ["R1"]
+
+
+# --------------------------------------------------------------------------
+# R2: raw norm / bare sqrt in differentiated geometry
+
+def test_r2_trigger_and_near_miss(tmp_path):
+    _write(tmp_path, "esac_tpu/geometry/bad.py", """\
+        import jax.numpy as jnp
+
+        def normalize(v):
+            return v / jnp.linalg.norm(v, axis=-1, keepdims=True)
+
+        def dist(x):
+            return jnp.sqrt(jnp.sum(x * x))
+        """)
+    _write(tmp_path, "esac_tpu/geometry/good.py", """\
+        import jax.numpy as jnp
+        from esac_tpu.utils.num import safe_norm
+
+        _SQRT_EPS = 1e-18
+
+        def normalize(v):
+            return v / safe_norm(v)[..., None]
+
+        def dist(x):
+            return jnp.sqrt(jnp.sum(x * x) + 1e-12)   # eps inside the sqrt
+
+        def cdist(z):
+            return jnp.sqrt(z + _SQRT_EPS)             # named eps
+        """)
+    _write(tmp_path, "esac_tpu/data/outside_scope.py", """\
+        import jax.numpy as jnp
+
+        def n(v):
+            return jnp.linalg.norm(v)
+        """)
+    findings = run_layer1(tmp_path)
+    assert _rules(findings) == ["R2", "R2"]
+    assert all(f.path == "esac_tpu/geometry/bad.py" for f in findings)
+
+
+# --------------------------------------------------------------------------
+# R3: scalar-loop linalg reachable from jit/vmap
+
+def test_r3_trigger_and_near_miss(tmp_path):
+    _write(tmp_path, "esac_tpu/ransac/solver.py", """\
+        import jax
+        import jax.numpy as jnp
+
+        def _helper(A, b):
+            return jnp.linalg.solve(A, b)      # reachable via hot() -> R3
+
+        @jax.jit
+        def hot(A, b):
+            return _helper(A, b)
+
+        def cold(A, b):
+            return jnp.linalg.svd(A)           # never jitted/vmapped: no R3
+        """)
+    findings = run_layer1(tmp_path)
+    assert _rules(findings) == ["R3"]
+    assert "solve" in findings[0].text
+
+
+def test_r3_sees_the_repo_shard_map_alias(tmp_path):
+    # Every shard_map in the package goes through the parallel.mesh compat
+    # alias; R3 must treat it as a hot-path root exactly like jax.shard_map.
+    _write(tmp_path, "esac_tpu/parallel/sharded.py", """\
+        from functools import partial
+
+        import jax.numpy as jnp
+        from esac_tpu.parallel.mesh import shard_map
+
+        @partial(shard_map, mesh=None, in_specs=(), out_specs=())
+        def local_step(A, b):
+            return jnp.linalg.solve(A, b)
+        """)
+    findings = run_layer1(tmp_path)
+    assert _rules(findings) == ["R3"]
+    assert "solve" in findings[0].text
+
+
+def test_r3_vmap_callsite_roots_and_cross_module(tmp_path):
+    _write(tmp_path, "esac_tpu/geometry/alg.py", """\
+        import jax.numpy as jnp
+
+        def invert(A):
+            return jnp.linalg.inv(A)
+        """)
+    _write(tmp_path, "esac_tpu/ransac/driver.py", """\
+        import jax
+        from esac_tpu.geometry.alg import invert
+
+        def run(As):
+            return jax.vmap(lambda A: invert(A))(As)
+        """)
+    findings = run_layer1(tmp_path)
+    assert _rules(findings) == ["R3"]
+    assert findings[0].path == "esac_tpu/geometry/alg.py"
+
+
+# --------------------------------------------------------------------------
+# R4: unpinned contractions in precision-pinned modules
+
+def test_r4_trigger_and_near_miss(tmp_path):
+    _write(tmp_path, "esac_tpu/geometry/rot.py", """\
+        import jax.numpy as jnp
+
+        def compose(a, b):
+            return jnp.matmul(a, b)
+
+        def compose_op(a, b):
+            return a @ b
+        """)
+    _write(tmp_path, "esac_tpu/geometry/rot_ok.py", """\
+        import jax
+        import jax.numpy as jnp
+
+        def compose(a, b):
+            return jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)
+        """)
+    _write(tmp_path, "esac_tpu/models/net.py", """\
+        import jax.numpy as jnp
+
+        def dense(a, b):
+            return jnp.matmul(a, b)    # CNN-side module: not pinned scope
+        """)
+    findings = run_layer1(tmp_path)
+    assert _rules(findings) == ["R4", "R4"]
+    assert all(f.path == "esac_tpu/geometry/rot.py" for f in findings)
+
+
+# --------------------------------------------------------------------------
+# R5: config dataclasses must be frozen
+
+def test_r5_trigger_and_near_miss(tmp_path):
+    _write(tmp_path, "esac_tpu/confs.py", """\
+        import dataclasses
+        from dataclasses import dataclass
+
+        @dataclass
+        class BadConfig:
+            n: int = 1
+
+        @dataclasses.dataclass(frozen=True)
+        class GoodConfig:
+            n: int = 1
+
+        @dataclass
+        class Frame:            # not a *Config: data record, no static-arg use
+            n: int = 1
+        """)
+    findings = run_layer1(tmp_path)
+    assert _rules(findings) == ["R5"]
+    assert "BadConfig" in findings[0].message
+
+
+# --------------------------------------------------------------------------
+# R6: force-CPU guard in ad-hoc scripts
+
+def test_r6_trigger_and_near_misses(tmp_path):
+    _write(tmp_path, "tools/bad_tool.py", """\
+        import jax
+
+        def main():
+            print(jax.devices())
+        """)
+    _write(tmp_path, "tools/good_tool.py", """\
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+        def main():
+            print(jax.devices())
+        """)
+    _write(tmp_path, "tools/stdlib_tool.py", """\
+        import json
+
+        def main():
+            print(json.dumps({}))
+        """)
+    _write(tmp_path, "esac_tpu/library.py", """\
+        import jax                 # library module: R6 is script-scope only
+        """)
+    findings = run_layer1(tmp_path)
+    assert _rules(findings) == ["R6"]
+    assert findings[0].path == "tools/bad_tool.py"
+
+
+def test_r6_esac_tpu_import_counts_as_jax_adjacent(tmp_path):
+    _write(tmp_path, "experiments/probe.py", """\
+        from esac_tpu.ransac import RansacConfig
+        """)
+    assert _rules(run_layer1(tmp_path)) == ["R6"]
+
+
+# --------------------------------------------------------------------------
+# R7: shell timeout/kill around python
+
+def test_r7_trigger_and_near_miss(tmp_path):
+    _write(tmp_path, "experiments/bad.sh", """\
+        #!/bin/sh
+        timeout 600 python train_esac.py --cpu
+        kill $TRAINER_PID
+        """)
+    _write(tmp_path, "experiments/good.sh", """\
+        #!/bin/sh
+        # never kill the trainer (prose mention is fine)
+        while kill -0 $TRAINER_PID 2>/dev/null; do sleep 5; done
+        setsid nohup python tools/tpu_probe.py > probe.log 2>&1 &
+        """)
+    findings = run_layer1(tmp_path)
+    assert _rules(findings) == ["R7", "R7"]
+    assert all(f.path == "experiments/bad.sh" for f in findings)
+
+
+# --------------------------------------------------------------------------
+# suppressions
+
+def test_inline_suppression_silences_finding(tmp_path):
+    _write(tmp_path, "esac_tpu/geometry/sup.py", """\
+        import jax.numpy as jnp
+
+        def n(v):
+            return jnp.linalg.norm(v)  # graft-lint: disable=R2(fixture reason)
+        """)
+    assert run_layer1(tmp_path) == []
+
+
+def test_file_level_suppression(tmp_path):
+    _write(tmp_path, "tools/chip_tool.py", """\
+        # graft-lint: disable-file=R6(sanctioned chip toucher - fixture)
+        import jax
+        """)
+    assert run_layer1(tmp_path) == []
+
+
+def test_shell_suppression(tmp_path):
+    _write(tmp_path, "experiments/sup.sh", """\
+        #!/bin/sh
+        kill $PID  # graft-lint: disable=R7(fixture: pid is a sleep, not jax)
+        """)
+    assert run_layer1(tmp_path) == []
+
+
+def test_suppression_parser():
+    per_line, per_file = parse_suppressions(
+        "x = 1  # graft-lint: disable=R1,R4(two rules one line)\n"
+        "# graft-lint: disable-file=R6(whole file)\n"
+    )
+    assert per_line == {1: {"R1", "R4"}}
+    assert per_file == {"R6"}
+
+
+def test_multiline_reason_does_not_widen_suppression():
+    # A reason that wraps to the next comment line (unclosed paren) ends the
+    # rule list: rule ids mentioned in the prose must not get suppressed.
+    per_line, per_file = parse_suppressions(
+        "# graft-lint: disable-file=R6(guards R2 and\n"
+        "# R3 style issues elsewhere)\n"
+    )
+    assert per_file == {"R6"}
+    assert per_line == {}
+
+
+# --------------------------------------------------------------------------
+# baseline: grandfathering + expiry
+
+def _one_r2_finding(tmp_path):
+    _write(tmp_path, "esac_tpu/geometry/base.py", """\
+        import jax.numpy as jnp
+
+        def n(v):
+            return jnp.linalg.norm(v)
+        """)
+    findings = run_layer1(tmp_path)
+    assert _rules(findings) == ["R2"]
+    return findings
+
+
+def test_baseline_masks_matching_finding(tmp_path):
+    findings = _one_r2_finding(tmp_path)
+    b = Baseline.from_findings(findings)
+    remaining, stale = b.apply(findings)
+    assert remaining == [] and stale == []
+
+
+def test_baseline_is_line_number_independent(tmp_path):
+    findings = _one_r2_finding(tmp_path)
+    f = findings[0]
+    b = Baseline([BaselineEntry(rule=f.rule, path=f.path, text=f.text)])
+    # Same offending line, shifted by edits above it: still masked.
+    shifted = [type(f)(f.rule, f.path, f.line + 10, f.text, f.message)]
+    remaining, stale = b.apply(shifted)
+    assert remaining == [] and stale == []
+
+
+def test_baseline_expiry_resurfaces_finding(tmp_path):
+    findings = _one_r2_finding(tmp_path)
+    f = findings[0]
+    expired = BaselineEntry(rule=f.rule, path=f.path, text=f.text,
+                            expires="2026-01-01")
+    b = Baseline([expired])
+    remaining, stale = b.apply(findings,
+                               today=datetime.date(2026, 6, 1))
+    assert remaining == findings          # mask no longer applies
+    assert stale == [expired]             # and the entry is reported stale
+    # Before expiry the same entry still masks.
+    remaining, stale = b.apply(findings,
+                               today=datetime.date(2025, 12, 1))
+    assert remaining == [] and stale == []
+
+
+def test_baseline_unused_entry_is_stale(tmp_path):
+    b = Baseline([BaselineEntry(rule="R2", path="gone.py", text="x = 1")])
+    remaining, stale = b.apply([])
+    assert remaining == [] and len(stale) == 1
+
+
+def test_baseline_roundtrip(tmp_path):
+    findings = _one_r2_finding(tmp_path)
+    path = tmp_path / "baseline.json"
+    Baseline.from_findings(findings).write(path)
+    loaded = Baseline.load(path)
+    remaining, _ = loaded.apply(findings)
+    assert remaining == []
+    assert json.loads(path.read_text())["entries"]
+
+
+# --------------------------------------------------------------------------
+# CLI exit codes (driver contract: 0 clean, 1 findings, 2 internal error)
+
+def test_cli_exit_1_on_seeded_violations_of_every_rule(tmp_path, capsys):
+    _write(tmp_path, "esac_tpu/r1.py",
+           "import jax.numpy as jnp\nX = jnp.zeros(3)\n")
+    _write(tmp_path, "esac_tpu/geometry/r2.py",
+           "import jax.numpy as jnp\n\ndef n(v):\n"
+           "    return jnp.linalg.norm(v)\n")
+    _write(tmp_path, "esac_tpu/ransac/r3.py",
+           "import jax\nimport jax.numpy as jnp\n\n@jax.jit\ndef h(A, b):\n"
+           "    return jnp.linalg.solve(A, b)\n")
+    _write(tmp_path, "esac_tpu/geometry/r4.py",
+           "import jax.numpy as jnp\n\ndef m(a, b):\n"
+           "    return jnp.matmul(a, b)\n")
+    _write(tmp_path, "esac_tpu/r5.py",
+           "from dataclasses import dataclass\n\n@dataclass\n"
+           "class LintFixtureConfig:\n    n: int = 1\n")
+    _write(tmp_path, "tools/r6.py", "import jax\n")
+    _write(tmp_path, "experiments/r7.sh", "timeout 5 python x.py\n")
+    rc = lint_main(["--root", str(tmp_path), "--no-jaxpr"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    for rule in ("R1", "R2", "R3", "R4", "R5", "R6", "R7"):
+        assert f" {rule} " in out, f"{rule} missing from CLI output:\n{out}"
+
+
+def test_cli_exit_0_on_clean_tree(tmp_path, capsys):
+    _write(tmp_path, "esac_tpu/ok.py", "import numpy as np\nX = np.zeros(3)\n")
+    assert lint_main(["--root", str(tmp_path), "--no-jaxpr"]) == 0
+
+
+def test_cli_exit_2_on_malformed_baseline(tmp_path, capsys):
+    # Driver contract: a broken baseline file is an internal error (2),
+    # never to be misread as findings (1).
+    _write(tmp_path, "esac_tpu/ok.py", "import numpy as np\n")
+    bad = tmp_path / "baseline.json"
+    bad.write_text('{"entries": [{"rule": "R2", "bogus": 1}]}\n')
+    assert lint_main(["--root", str(tmp_path), "--no-jaxpr",
+                      "--baseline", str(bad)]) == 2
+
+
+def test_changed_mode_audits_on_utils_edits():
+    # utils/precision.py and utils/num.py carry the invariants the jaxpr
+    # audit enforces; a --changed run touching them must include layer 2.
+    from esac_tpu.lint.cli import _audit_needed
+
+    assert _audit_needed(["esac_tpu/utils/precision.py"])
+    assert _audit_needed(["esac_tpu/utils/num.py"])
+    assert not _audit_needed(["tools/eval_agreement.py", "LINT.md"])
+
+
+def test_cli_write_baseline_then_clean(tmp_path, capsys):
+    _write(tmp_path, "esac_tpu/geometry/r2.py",
+           "import jax.numpy as jnp\n\ndef n(v):\n"
+           "    return jnp.linalg.norm(v)\n")
+    base = tmp_path / "baseline.json"
+    assert lint_main(["--root", str(tmp_path), "--no-jaxpr",
+                      "--baseline", str(base), "--write-baseline"]) == 0
+    assert lint_main(["--root", str(tmp_path), "--no-jaxpr",
+                      "--baseline", str(base)]) == 0
+
+
+def test_cli_write_baseline_refuses_scoped_runs(tmp_path, capsys):
+    # A scoped --write-baseline would replace the whole file with the
+    # slice's findings, deleting every entry for unscanned files.
+    rel = _write(tmp_path, "esac_tpu/geometry/r2.py",
+                 "import jax.numpy as jnp\n\ndef n(v):\n"
+                 "    return jnp.linalg.norm(v)\n")
+    base = tmp_path / "baseline.json"
+    assert lint_main(["--root", str(tmp_path), "--no-jaxpr",
+                      "--baseline", str(base), "--write-baseline", rel]) == 2
+    assert not base.exists()
+
+
+# --------------------------------------------------------------------------
+# layer 2: jaxpr auditor
+
+def test_audit_flags_unpinned_dot_in_pinned_graph():
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.lint.jaxpr_audit import audit_jaxpr
+
+    a = jnp.zeros((3, 3))
+    closed = jax.make_jaxpr(lambda x, y: jnp.matmul(x, y))(a, a)
+    findings = audit_jaxpr("fixture", closed, pinned=True)
+    assert [f.rule for f in findings] == ["J3"]
+    # The identical trace in an unpinned graph is fine.
+    assert audit_jaxpr("fixture", closed, pinned=False) == []
+
+
+def test_audit_accepts_hmm():
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.lint.jaxpr_audit import audit_jaxpr
+    from esac_tpu.utils.precision import hmm
+
+    a = jnp.zeros((3, 3))
+    closed = jax.make_jaxpr(hmm)(a, a)
+    assert audit_jaxpr("fixture", closed, pinned=True) == []
+
+
+def test_audit_flags_while_loop():
+    import jax
+
+    from esac_tpu.lint.jaxpr_audit import audit_jaxpr
+
+    def dynamic_trip(x):
+        return jax.lax.while_loop(
+            lambda v: v[0] < 8, lambda v: (v[0] + 1, v[1] * 0.5), (0, x)
+        )[1]
+
+    closed = jax.make_jaxpr(dynamic_trip)(1.0)
+    findings = audit_jaxpr("fixture", closed, pinned=False)
+    assert any(f.rule == "J1" and f.text == "while" for f in findings)
+
+
+def test_audit_recurses_into_scan_and_jit():
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.lint.jaxpr_audit import audit_jaxpr
+
+    @jax.jit
+    def scanned(x):
+        def body(carry, _):
+            return jnp.matmul(carry, carry), None
+
+        out, _ = jax.lax.scan(body, x, None, length=3)
+        return out
+
+    closed = jax.make_jaxpr(scanned)(jnp.eye(3))
+    findings = audit_jaxpr("fixture", closed, pinned=True)
+    assert [f.rule for f in findings] == ["J3"]  # found inside scan-in-pjit
+
+
+def test_registered_entry_points_audit_clean():
+    """The acceptance gate: every registry entry traces on CPU with zero
+    disallowed primitives, static shapes, and pinned call graphs at
+    HIGHEST/f32 — the jaxpr-level form of the CLAUDE.md conventions."""
+    from esac_tpu.lint.jaxpr_audit import run_audit
+
+    findings = run_audit()
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+# --------------------------------------------------------------------------
+# the tier-1 gate: committed tree clean modulo baseline
+
+def test_committed_tree_is_clean_modulo_baseline():
+    findings = run_layer1(REPO)
+    baseline = Baseline.load(REPO / "lint_baseline.json")
+    remaining, _ = baseline.apply(findings)
+    assert remaining == [], "\n".join(f.format() for f in remaining)
+
+
+def test_committed_baseline_has_no_stale_entries():
+    findings = run_layer1(REPO)
+    baseline = Baseline.load(REPO / "lint_baseline.json")
+    _, stale = baseline.apply(findings)
+    assert stale == [], f"stale baseline entries: {stale}"

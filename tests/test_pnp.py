@@ -136,6 +136,27 @@ def test_degenerate_sample_gradient_is_finite():
     assert jnp.all(jnp.isfinite(g)), g
 
 
+def test_bearings_normalization_grad_finite_at_degenerate_input():
+    """Regression for the raw jnp.linalg.norm ray normalization in
+    bearings() (graft-lint R2): gradients must stay finite at degenerate
+    inputs, per the CLAUDE.md finite-garbage-plus-penalty convention.
+
+    Two layers: (a) bearings() itself at the principal point (xy == 0
+    exactly — the degenerate pinhole-center ray); (b) the safe_norm
+    normalization at a true all-zero ray, which is exactly the input where
+    the old raw-norm VJP returned NaN (0/0 in the norm backward)."""
+    from esac_tpu.geometry.pnp import bearings
+    from esac_tpu.utils.num import safe_norm
+
+    x2d = jnp.tile(C[None], (4, 1))  # every pixel at the principal point
+    g = jax.grad(lambda p: jnp.sum(bearings(p, F, C)))(x2d)
+    assert jnp.all(jnp.isfinite(g)), g
+
+    zero_rays = jnp.zeros((4, 3))    # the zero ray a raw norm NaNs on
+    g2 = jax.grad(lambda r: jnp.sum(r / safe_norm(r)[..., None]))(zero_rays)
+    assert jnp.all(jnp.isfinite(g2)), g2
+
+
 def test_so3_log_gradient_at_identity():
     g = jax.grad(lambda R: jnp.sum(so3_log(R)))(jnp.eye(3))
     assert jnp.all(jnp.isfinite(g))

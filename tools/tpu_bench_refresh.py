@@ -18,6 +18,10 @@ snapshot time.
 
 from __future__ import annotations
 
+# graft-lint: disable-file=R6(refuses to run OFF the chip — it refreshes the
+# committed hardware record and exits if the backend is not TPU; only ever
+# invoked from chip_recovery.sh's sanctioned post-probe queue)
+
 import datetime
 import json
 import pathlib

@@ -20,6 +20,10 @@ a probe is unresolved.
 
 from __future__ import annotations
 
+# graft-lint: disable-file=R6(this probe EXISTS to touch the chip: it is the
+# sanctioned relay-liveness check, launched detached and never killed; a
+# force-CPU guard would defeat its purpose)
+
 import json
 import os
 import time
