@@ -165,6 +165,46 @@ def _build_esac_train_grad():
     return jax.make_jaxpr(jax.grad(loss, argnums=(0, 1)))(coords_all, logits)
 
 
+def _build_dsac_infer_frames():
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.ransac.config import RansacConfig
+    from esac_tpu.ransac.kernel import dsac_infer_frames
+
+    coords, pixels, f, c = _geom_inputs()
+    B = 2
+    cfg = RansacConfig(n_hyps=8, refine_iters=2, polish_iters=1)
+    keys = jax.random.split(jax.random.key(6), B)
+    coords_B = jnp.stack([coords, coords + 0.1])
+    pixels_B = jnp.stack([pixels, pixels])
+    f_B = jnp.stack([f, f])
+    return jax.make_jaxpr(
+        lambda k, co: dsac_infer_frames(k, co, pixels_B, f_B, c, cfg)
+    )(keys, coords_B)
+
+
+def _build_esac_infer_frames():
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.ransac.config import RansacConfig
+    from esac_tpu.ransac.esac import esac_infer_frames
+
+    coords, pixels, f, c = _geom_inputs()
+    B, M = 2, 2
+    cfg = RansacConfig(n_hyps=8, refine_iters=2, polish_iters=1)
+    keys = jax.random.split(jax.random.key(7), B)
+    coords_all = jnp.stack([coords, coords + 0.1])          # (M, N, 3)
+    coords_B = jnp.stack([coords_all, coords_all + 0.05])   # (B, M, N, 3)
+    logits_B = jnp.zeros((B, M))
+    pixels_B = jnp.stack([pixels, pixels])
+    f_B = jnp.stack([f, f])
+    return jax.make_jaxpr(
+        lambda k, co: esac_infer_frames(k, logits_B, co, pixels_B, f_B, c, cfg)
+    )(keys, coords_B)
+
+
 def _build_sharded_train():
     import jax
 
@@ -224,6 +264,11 @@ ENTRIES: tuple[Entry, ...] = (
     Entry("esac_train_loss_dense_grad", pinned=True,
           build=_build_esac_train_grad,
           note="multi-expert dense training loss + backward"),
+    Entry("dsac_infer_frames", pinned=True, build=_build_dsac_infer_frames,
+          note="frames-major serving dispatch (esac_tpu.serve): B frames "
+               "per dispatch, the DESIGN.md §9 amortization path"),
+    Entry("esac_infer_frames", pinned=True, build=_build_esac_infer_frames,
+          note="frames-major multi-expert serving dispatch"),
     Entry("sharded_train_step", pinned=False, build=_build_sharded_train,
           note="EP+DP shard_map loss, forward only; CNN compute is "
                "legitimately bf16 so dot precision is not audited here"),

@@ -22,7 +22,8 @@ see PARALLELISM.md at the repo root for the explicit mapping.
 
 from esac_tpu.parallel.mesh import make_mesh, expert_sharding, batch_sharding
 from esac_tpu.parallel.esac_sharded import (
-    esac_infer_routed, esac_infer_sharded, pad_experts_for_mesh,
+    esac_infer_routed, esac_infer_sharded, esac_infer_sharded_frames,
+    make_esac_infer_sharded_frames, pad_experts_for_mesh,
     pad_gating_logits,
 )
 from esac_tpu.parallel.multihost import initialize_multihost
@@ -34,7 +35,9 @@ __all__ = [
     "batch_sharding",
     "esac_infer_routed",
     "esac_infer_sharded",
+    "esac_infer_sharded_frames",
     "initialize_multihost",
+    "make_esac_infer_sharded_frames",
     "make_sharded_esac_loss",
     "pad_experts_for_mesh",
     "pad_gating_logits",

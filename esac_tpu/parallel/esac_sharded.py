@@ -119,6 +119,104 @@ def esac_infer_sharded(
     return jax.jit(body)(key, coords_all, pixels)
 
 
+def make_esac_infer_sharded_frames(
+    mesh: Mesh,
+    c: jnp.ndarray,
+    cfg: RansacConfig = RansacConfig(),
+    as_tree: bool = False,
+):
+    """Build the frames-major sharded inference entry (built ONCE so the
+    serving path gets a stable jit cache: one compile per frame bucket).
+
+    Returned callable takes ``(keys, coords_all, pixels, f)`` with keys
+    (B,) typed PRNG keys, coords_all (B, M, N, 3) — M divisible by the
+    mesh's expert axis — pixels (B, N, 2) and f (B,) per-frame focals, and
+    returns a dict of replicated (B,)-leading results (rvec, tvec, expert,
+    score).  Per shard, the per-frame local-winner work is vmapped over B
+    so P3P/selection/refine run once per dispatch, then the batched argmax
+    all-reduce (`_winner_allreduce` is elementwise over leading axes)
+    selects each frame's global winner.  ``as_tree=True`` makes it a
+    one-argument callable over a frame-stacked tree (leaves ``key``,
+    ``coords_all``, ``pixels``, ``f``) — the MicroBatchDispatcher contract
+    (serve.make_sharded_serve_fn).
+    """
+    n_shards = mesh.shape["expert"]
+    c = jnp.asarray(c)
+    specs = {
+        "key": P(), "coords_all": P(None, "expert"), "pixels": P(), "f": P(),
+    }
+
+    @partial(
+        shard_map, mesh=mesh, in_specs=(specs,),
+        out_specs=(P(), P(), P(), P()),
+    )
+    def body(batch):
+        coords_local = batch["coords_all"]  # (B, m_local, N, 3)
+        m_local = coords_local.shape[1]
+        M = m_local * n_shards
+        shard_id = jax.lax.axis_index("expert")
+
+        def one_frame(k, coords_m, px, fi):
+            # Same key discipline as esac_infer_sharded: the score-subsample
+            # key splits BEFORE the per-shard fold so every shard scores on
+            # the same cell subset; only the hypothesis key is per-shard.
+            k_hyp, k_sub = _split_score_key(k, cfg)
+            k_local = jax.random.fold_in(k_hyp, shard_id)
+            rvecs, tvecs, scores = _per_expert_hypotheses(
+                k_local, coords_m, px, fi, c, cfg, score_key=k_sub,
+            )  # (m_local, nh, 3), (m_local, nh)
+            flat = jnp.argmax(scores.reshape(-1))
+            mi, j = flat // scores.shape[1], flat % scores.shape[1]
+            rvec, tvec = refine_soft_inliers(
+                rvecs[mi, j], tvecs[mi, j], coords_m[mi], px, fi, c,
+                cfg.tau, cfg.beta, iters=cfg.refine_iters,
+            )
+            return rvec, tvec, scores[mi, j], shard_id * m_local + mi
+
+        rvec, tvec, local_score, g_expert = jax.vmap(one_frame)(
+            batch["key"], coords_local, batch["pixels"], batch["f"]
+        )
+        return _winner_allreduce(local_score, g_expert, rvec, tvec, M)
+
+    @jax.jit
+    def infer_tree(batch):
+        M = batch["coords_all"].shape[1]
+        if M % n_shards != 0:
+            raise ValueError(
+                f"M={M} not divisible by expert shards {n_shards}"
+            )
+        rvec, tvec, expert, score = body(batch)
+        return {"rvec": rvec, "tvec": tvec, "expert": expert, "score": score}
+
+    if as_tree:
+        return infer_tree
+
+    def infer(keys, coords_all, pixels, f):
+        return infer_tree({
+            "key": keys, "coords_all": coords_all, "pixels": pixels, "f": f,
+        })
+
+    return infer
+
+
+def esac_infer_sharded_frames(
+    mesh: Mesh,
+    keys: jax.Array,
+    coords_all: jnp.ndarray,
+    pixels: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    cfg: RansacConfig = RansacConfig(),
+) -> dict:
+    """Direct-call frames-major sharded inference (shapes as documented on
+    :func:`make_esac_infer_sharded_frames`).  Rebuilds the shard_map body
+    per call, matching ``esac_infer_sharded``'s surface; serving callers
+    wanting a stable jit cache should hold the built fn instead."""
+    return make_esac_infer_sharded_frames(mesh, c, cfg)(
+        keys, coords_all, pixels, f
+    )
+
+
 def pad_experts_for_mesh(e_stack, centers, n_shards: int):
     """Pad stacked expert params / scene centers so the expert count divides
     ``n_shards``.

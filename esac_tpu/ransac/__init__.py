@@ -13,11 +13,18 @@ from esac_tpu.ransac.scoring import reprojection_error_map, soft_inlier_score
 from esac_tpu.ransac.refine import refine_soft_inliers
 from esac_tpu.ransac.kernel import (
     dsac_infer,
+    dsac_infer_frames,
     dsac_train_loss,
     generate_hypotheses,
     pose_loss,
 )
-from esac_tpu.ransac.esac import esac_infer, esac_infer_topk, esac_train_loss
+from esac_tpu.ransac.esac import (
+    esac_infer,
+    esac_infer_frames,
+    esac_infer_topk,
+    esac_infer_topk_frames,
+    esac_train_loss,
+)
 
 __all__ = [
     "RansacConfig",
@@ -27,9 +34,12 @@ __all__ = [
     "refine_soft_inliers",
     "generate_hypotheses",
     "dsac_infer",
+    "dsac_infer_frames",
     "dsac_train_loss",
     "esac_infer",
+    "esac_infer_frames",
     "esac_infer_topk",
+    "esac_infer_topk_frames",
     "esac_train_loss",
     "pose_loss",
 ]

@@ -83,3 +83,20 @@ class RansacConfig:
     # activation memory — needed for config-#5-scale training
     # (4096 hypotheses x 4800 cells) on one chip's HBM.
     remat: bool = False
+    # ---- Frame-axis serving knobs (esac_tpu.serve; DESIGN.md §9) ----
+    # NOTE: like every field here, these participate in the config's
+    # static-arg hash — a config with different serve knobs is a new
+    # compiled-program family even for kernels that never read them.  Pick
+    # the knobs once per serving process (build the serve fn, keep it);
+    # don't tune queue knobs against a live jit cache.
+    # Allowed frame-batch sizes for the micro-batching dispatcher.  Every
+    # dispatch is padded up to one of these, so jit compiles exactly one
+    # program per bucket (static shapes) no matter how requests arrive.
+    frame_buckets: tuple[int, ...] = (1, 4, 16, 64)
+    # How long the dispatcher's worker holds the FIRST queued request while
+    # waiting for more frames to fill a bucket.  0 disables coalescing
+    # (every request dispatches alone — per-frame-call semantics).
+    serve_max_wait_ms: float = 2.0
+    # Backpressure bound on queued-but-undispatched requests; submitters
+    # block (never drop) once the queue is full.
+    serve_queue_depth: int = 256

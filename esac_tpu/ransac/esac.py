@@ -122,6 +122,30 @@ def esac_infer(
     }
 
 
+@partial(jax.jit, static_argnames=("cfg",))
+def esac_infer_frames(
+    keys: jax.Array,
+    gating_logits: jnp.ndarray,
+    coords_all: jnp.ndarray,
+    pixels: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    cfg: RansacConfig = RansacConfig(),
+) -> dict:
+    """Frames-major ``esac_infer``: B frames in ONE dispatch.
+
+    keys (B,) typed PRNG keys, gating_logits (B, M), coords_all
+    (B, M, N, 3), pixels (B, N, 2), f (B,) per-frame focals, c (2,)
+    shared.  P3P, the global argmax and the winner-only IRLS refine run
+    once per dispatch vmapped over frames (DESIGN.md §9's frame-axis
+    amortization); per-frame semantics are ``esac_infer``'s, with every
+    output gaining a leading (B,) axis.
+    """
+    return jax.vmap(
+        lambda k, g, ca, px, fi: esac_infer(k, g, ca, px, fi, c, cfg)
+    )(keys, gating_logits, coords_all, pixels, f)
+
+
 def _expected_losses_per_expert(rvecs, tvecs, scores, coords_all, pixels, f, c, R_gt, t_gt, cfg):
     """Within-expert softmax-selection expectation of the refined pose loss.
 
@@ -181,6 +205,27 @@ def esac_infer_topk(
         # align with 'experts_evaluated', not with expert index.
         "gating_probs": jax.nn.softmax(gating_logits),
     }
+
+
+@partial(jax.jit, static_argnames=("cfg", "k"))
+def esac_infer_topk_frames(
+    keys: jax.Array,
+    gating_logits: jnp.ndarray,
+    coords_all: jnp.ndarray,
+    pixels: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    cfg: RansacConfig = RansacConfig(),
+    k: int = 4,
+) -> dict:
+    """Frames-major ``esac_infer_topk``: gating-pruned experts, B frames in
+    one dispatch.  Shapes as in :func:`esac_infer_frames`; each frame's
+    top-k expert subset is selected from its own gating row."""
+    return jax.vmap(
+        lambda kk, g, ca, px, fi: esac_infer_topk(
+            kk, g, ca, px, fi, c, cfg, k=k
+        )
+    )(keys, gating_logits, coords_all, pixels, f)
 
 
 @partial(jax.jit, static_argnames=("cfg", "mode"))

@@ -162,6 +162,32 @@ def dsac_infer(
 
 
 @partial(jax.jit, static_argnames=("cfg",))
+def dsac_infer_frames(
+    keys: jax.Array,
+    coords: jnp.ndarray,
+    pixels: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    cfg: RansacConfig = RansacConfig(),
+) -> dict:
+    """Frames-major inference: the whole batch rides ONE dispatch.
+
+    keys (B,) typed PRNG keys, coords (B, N, 3), pixels (B, N, 2), f (B,)
+    per-frame focals, c (2,) shared principal point.  Sampling, P3P,
+    scoring, argmax selection and the winner-only IRLS loop each run once
+    per *dispatch*, vmapped over frames — the amortization lever of
+    DESIGN.md §9: the serial small-tensor chain's op-latency floor is paid
+    per dispatch, not per frame.  Per-frame results match ``dsac_infer``
+    semantically; the serving path (esac_tpu.serve) additionally guarantees
+    bit-identical results across frame-batch sizes by keeping every
+    dispatch at >= 2 physical lanes (serve.batching.MIN_LANES).
+    """
+    return jax.vmap(
+        lambda k, co, px, fi: dsac_infer(k, co, px, fi, c, cfg)
+    )(keys, coords, pixels, f)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
 def dsac_train_loss(
     key: jax.Array,
     coords: jnp.ndarray,

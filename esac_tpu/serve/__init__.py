@@ -1,0 +1,34 @@
+"""Frame-axis batched serving: micro-batching dispatcher + bucketed shapes.
+
+DESIGN.md §9's ≥2x lever made code: single-frame requests are coalesced
+into fixed, bucketed frame-batch dispatches so the serial small-tensor
+chain (P3P, argmax selection, winner-only IRLS) pays its op-latency floor
+once per *dispatch* instead of once per frame.  See serve.batching for the
+static-shape/padding invariants and serve.dispatcher for the request path.
+"""
+
+from esac_tpu.serve.batching import (
+    MIN_LANES,
+    pad_batch,
+    pick_bucket,
+    plan_dispatches,
+    stack_frames,
+)
+from esac_tpu.serve.dispatcher import (
+    MicroBatchDispatcher,
+    make_dsac_serve_fn,
+    make_esac_serve_fn,
+    make_sharded_serve_fn,
+)
+
+__all__ = [
+    "MIN_LANES",
+    "MicroBatchDispatcher",
+    "make_dsac_serve_fn",
+    "make_esac_serve_fn",
+    "make_sharded_serve_fn",
+    "pad_batch",
+    "pick_bucket",
+    "plan_dispatches",
+    "stack_frames",
+]

@@ -1,0 +1,126 @@
+"""Bucketed frame batching: static shapes for the serving path.
+
+Requests arrive one frame at a time; jit compiles one program per distinct
+input shape.  Serving therefore quantizes every dispatch to a fixed bucket
+list (``RansacConfig.frame_buckets``): the dispatcher picks the smallest
+bucket that holds the pending frames and pads the tail, so the number of
+compiled programs is bounded by ``len(frame_buckets)`` no matter how
+traffic arrives (the compile-once property is pinned by
+tests/test_serve.py's cache-miss counter).
+
+Two invariants make padding safe:
+
+- **Lane independence**: the frames-major entry points are ``vmap``s of the
+  per-frame pipeline, so a padded lane cannot perturb a real frame's
+  result — selection and refine are per-lane; there is no cross-frame
+  reduction.  Pad content is the last real frame repeated (well-conditioned
+  by construction), but even degenerate pad data only produces finite
+  garbage in its own discarded lane (the utils.num total-function
+  discipline).
+- **Bucket invariance, bitwise**: XLA specializes a collapsed (B=1) batch
+  axis differently enough to change float results, while every width >= 2
+  compiles to bit-identical per-lane programs (measured on CPU across
+  widths 2..64 for both the dsac and esac paths).  Every dispatch therefore
+  carries at least ``MIN_LANES`` physical lanes — a single-frame dispatch
+  pads to 2 — so a request's result is bit-identical no matter which bucket
+  it rides.  The cost is one wasted lane on bucket-1 dispatches, recorded
+  honestly as ``physical_lanes`` in the serve bench artifact.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Smallest physical frame-batch any dispatch runs at (see module docstring).
+MIN_LANES = 2
+
+
+def pick_bucket(n: int, buckets: tuple[int, ...]) -> int:
+    """Smallest bucket >= n.  ``n`` above the largest bucket is a planning
+    error — :func:`plan_dispatches` splits bulk requests first."""
+    if n < 1:
+        raise ValueError(f"need at least one frame, got {n}")
+    for b in sorted(set(buckets)):
+        if b >= n:
+            return b
+    raise ValueError(f"{n} frames exceed the largest bucket {max(buckets)}")
+
+
+def _lanes(chunks: list[int], buckets: tuple[int, ...]) -> int:
+    """Total physical lanes a chunk list costs after bucket padding."""
+    return sum(max(pick_bucket(c, buckets), MIN_LANES) for c in chunks)
+
+
+def _plan_tail(rem: int, buckets: tuple[int, ...]) -> list[int]:
+    """Plan the sub-largest-bucket tail: either ONE padded dispatch, or the
+    largest fitting bucket plus a recursively planned remainder — whichever
+    costs fewer physical lanes (padded compute is real compute); ties go to
+    fewer dispatches (each dispatch pays the serial chain's op-latency
+    floor, the very cost this subsystem amortizes).  E.g. with buckets
+    (1, 4, 16, 64): 17 -> [16, 1] (18 lanes, not 64), 5 -> [4, 1], but
+    63 -> [63] (one 64-lane dispatch beats [16,16,16,15]'s four)."""
+    single = [rem]
+    fit = [b for b in sorted(set(buckets)) if b <= rem]
+    if not fit or rem in fit:
+        return single
+    split = [fit[-1]] + _plan_tail(rem - fit[-1], buckets)
+    if _lanes(split, buckets) < _lanes(single, buckets):
+        return split
+    return single
+
+
+def plan_dispatches(n: int, buckets: tuple[int, ...]) -> list[int]:
+    """Split ``n`` frames into per-dispatch valid-frame counts: full
+    largest-bucket dispatches, then a minimal-waste tail plan
+    (:func:`_plan_tail`).  Returns counts summing to ``n``; each count is
+    padded up by the caller via :func:`pick_bucket`."""
+    if n < 1:
+        raise ValueError(f"need at least one frame, got {n}")
+    big = max(buckets)
+    plan = [big] * (n // big)
+    rem = n - big * len(plan)
+    if rem:
+        plan += _plan_tail(rem, buckets)
+    return plan
+
+
+def _pad_leaf(x, extra: int):
+    """Append ``extra`` copies of the last frame along axis 0.  numpy leaves
+    stay on host (staging assembles there); jax arrays — typed PRNG keys
+    included — pad with jnp so the dtype survives."""
+    if extra == 0:
+        return x
+    if isinstance(x, np.ndarray):
+        return np.concatenate([x] + [x[-1:]] * extra, axis=0)
+    import jax.numpy as jnp
+
+    return jnp.concatenate([x] + [x[-1:]] * extra, axis=0)
+
+
+def stack_frames(frames: list[dict]) -> dict:
+    """Stack per-frame trees (dicts of arrays/scalars) along a new leading
+    frame axis.  numpy-stackable leaves stack on host; jax-typed leaves
+    (PRNG keys) via jnp."""
+    out = {}
+    for name in frames[0]:
+        leaves = [fr[name] for fr in frames]
+        try:
+            out[name] = np.stack([np.asarray(v) for v in leaves])
+        except (TypeError, ValueError):
+            import jax.numpy as jnp
+
+            out[name] = jnp.stack(leaves)
+    return out
+
+
+def pad_batch(batch: dict, bucket: int) -> tuple[dict, int]:
+    """Pad a frame-stacked tree up to ``max(bucket, MIN_LANES)`` physical
+    lanes by repeating the last real frame.  Returns (padded tree,
+    n_valid); results beyond ``n_valid`` are padding and must be dropped.
+    """
+    n_valid = len(next(iter(batch.values())))
+    lanes = max(bucket, MIN_LANES)
+    if n_valid > bucket:
+        raise ValueError(f"{n_valid} frames do not fit bucket {bucket}")
+    extra = lanes - n_valid
+    return {k: _pad_leaf(v, extra) for k, v in batch.items()}, n_valid

@@ -1,0 +1,313 @@
+"""Micro-batching dispatcher: single-frame requests -> frames-major dispatches.
+
+The serving front-end of DESIGN.md §9: the serial small-tensor chain (P3P,
+selection, winner-only IRLS) amortizes only by adding *frames* to a
+dispatch, so requests that arrive one frame at a time must be coalesced
+into fixed frame-batch shapes before they reach the chip.  This module is
+that coalescer:
+
+- ``infer_one`` — blocking single-request API.  A background worker holds
+  the first queued request up to ``cfg.serve_max_wait_ms`` while more
+  arrive, packs the queue into the smallest ``cfg.frame_buckets`` bucket,
+  pads the tail (serve.batching), and fans results back out.
+- ``infer_many`` — bulk API: plans bucket-sized dispatches and
+  double-buffers host-side staging against in-flight device compute (the
+  CLAUDE.md pre-stage/batch-work pattern generalized: while dispatch *i*
+  runs on device, dispatch *i+1* is stacked, padded and ``device_put``).
+
+The dispatcher is generic over the batched entry point: ``infer_fn`` takes
+one frame-stacked tree (every leaf with a leading physical-lane axis) and
+returns a tree with the same leading axis.  Builders for the shipped paths
+are below (``make_dsac_serve_fn``, ``make_esac_serve_fn``,
+``make_sharded_serve_fn``); each is a single ``jax.jit`` callable so one
+program compiles per bucket and the compile count is observable
+(``cache_size``, pinned by tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+from esac_tpu.ransac.config import RansacConfig
+from esac_tpu.serve.batching import (
+    pad_batch,
+    pick_bucket,
+    plan_dispatches,
+    stack_frames,
+)
+
+
+class _Request:
+    __slots__ = ("frame", "event", "result", "error", "t_submit")
+
+    def __init__(self, frame, t_submit):
+        self.frame = frame
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+        self.t_submit = t_submit
+
+
+class MicroBatchDispatcher:
+    """Accumulate single-frame requests into bucketed frames-major dispatches.
+
+    ``infer_fn``: batched callable, frame-stacked tree -> tree (leading axis
+    = physical lanes).  ``cfg`` supplies the static serving knobs
+    (``frame_buckets``, ``serve_max_wait_ms``, ``serve_queue_depth``).
+    ``start_worker=False`` skips the background thread: ``infer_one``
+    dispatches synchronously (per-frame-call semantics) and ``infer_many``
+    is unaffected — the mode used by benchmarks and equivalence tests.
+    """
+
+    def __init__(
+        self,
+        infer_fn,
+        cfg: RansacConfig = RansacConfig(),
+        start_worker: bool = True,
+        clock=time.perf_counter,
+    ):
+        self._infer = infer_fn
+        self._buckets = tuple(sorted(set(cfg.frame_buckets)))
+        self._max_wait_s = cfg.serve_max_wait_ms / 1e3
+        self._depth = cfg.serve_queue_depth
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)   # waiters: worker
+        self._space = threading.Condition(self._lock)  # waiters: submitters
+        self._pending: collections.deque[_Request] = collections.deque()
+        self._closed = False
+        # Bounded stats: a serving process runs for days — unbounded lists
+        # would leak and latency_quantiles() would sort the whole history
+        # under the dispatch lock.  Quantiles are over the recent window.
+        self.latencies_s: collections.deque[float] = collections.deque(
+            maxlen=100_000
+        )
+        self.dispatch_log: collections.deque[tuple[int, int]] = (
+            collections.deque(maxlen=10_000)  # (bucket, n_valid)
+        )
+        self._worker = None
+        if start_worker:
+            self.start()
+
+    def start(self):
+        """Start the background worker (idempotent).  Requests may be
+        ``submit``ted before start() — they dispatch on the first wakeup,
+        the deterministic sequencing the coalescing tests rely on.  Don't
+        race start() against ``infer_one`` from other threads: infer_one
+        picks its (sync vs queued) path by whether a worker exists."""
+        if self._worker is None:
+            self._worker = threading.Thread(
+                target=self._worker_loop, daemon=True, name="esac-serve"
+            )
+            self._worker.start()
+
+    # ---------------- request path ----------------
+
+    def submit(self, frame: dict) -> _Request:
+        """Enqueue one frame tree; returns a request whose ``event`` fires
+        when ``result`` (or ``error``) is set.  Blocks for queue space —
+        backpressure, never drops."""
+        req = _Request(frame, self._clock())
+        with self._work:
+            while len(self._pending) >= self._depth and not self._closed:
+                self._space.wait()
+            if self._closed:
+                raise RuntimeError("dispatcher is closed")
+            self._pending.append(req)
+            self._work.notify()
+        return req
+
+    def infer_one(self, frame: dict) -> dict:
+        """Blocking single-frame inference through the batching queue."""
+        if self._worker is None:
+            req = _Request(frame, self._clock())
+            self._run([req])
+        else:
+            req = self.submit(frame)
+            req.event.wait()
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def infer_many(self, frames: list[dict]) -> list[dict]:
+        """Bulk inference: bucket-planned dispatches, staging double-buffered
+        against in-flight compute.  Returns per-frame result trees (host
+        numpy), in input order."""
+        import jax
+        import numpy as np
+
+        t_submit = self._clock()
+        plan = plan_dispatches(len(frames), self._buckets)
+        bounds = []
+        lo = 0
+        for n in plan:
+            bounds.append((lo, lo + n))
+            lo += n
+
+        def stage(lo, hi):
+            padded, n_valid = pad_batch(
+                stack_frames(frames[lo:hi]), pick_bucket(hi - lo, self._buckets)
+            )
+            return jax.device_put(padded), n_valid
+
+        results: list[dict] = []
+        staged = stage(*bounds[0])
+        for i in range(len(bounds)):
+            tree, n_valid = staged
+            out = self._infer(tree)  # async dispatch: device compute starts
+            if i + 1 < len(bounds):
+                staged = stage(*bounds[i + 1])  # host staging overlaps compute
+            out = jax.block_until_ready(out)
+            t_done = self._clock()
+            host = jax.tree.map(np.asarray, out)
+            with self._lock:
+                self.dispatch_log.append(
+                    (pick_bucket(n_valid, self._buckets), n_valid)
+                )
+                self.latencies_s.extend([t_done - t_submit] * n_valid)
+            results.extend(
+                jax.tree.map(lambda x: x[j], host) for j in range(n_valid)
+            )
+        return results
+
+    # ---------------- worker ----------------
+
+    def _worker_loop(self):
+        big = self._buckets[-1]
+        while True:
+            with self._work:
+                while not self._pending and not self._closed:
+                    self._work.wait()
+                if not self._pending:
+                    return  # closed and drained
+                deadline = self._pending[0].t_submit + self._max_wait_s
+                while len(self._pending) < big and not self._closed:
+                    remaining = deadline - self._clock()
+                    if remaining <= 0:
+                        break
+                    self._work.wait(remaining)
+                # serve_max_wait_ms == 0 means coalescing is OFF: exactly one
+                # request per dispatch (per-frame-call semantics), even when
+                # a burst is already queued.
+                take = 1 if self._max_wait_s == 0 else min(
+                    len(self._pending), big
+                )
+                batch = [self._pending.popleft() for _ in range(take)]
+                self._space.notify_all()
+            self._run(batch)
+
+    def _run(self, reqs: list[_Request]):
+        try:
+            self._dispatch(reqs)
+        except Exception as e:  # noqa: BLE001 — fan the failure out
+            for r in reqs:
+                r.error = e
+                r.event.set()
+
+    def _dispatch(self, reqs: list[_Request]):
+        import jax
+        import numpy as np
+
+        bucket = pick_bucket(len(reqs), self._buckets)
+        padded, n_valid = pad_batch(
+            stack_frames([r.frame for r in reqs]), bucket
+        )
+        out = self._infer(jax.device_put(padded))
+        out = jax.block_until_ready(out)
+        t_done = self._clock()
+        host = jax.tree.map(np.asarray, out)
+        with self._lock:
+            self.dispatch_log.append((bucket, n_valid))
+            self.latencies_s.extend(t_done - r.t_submit for r in reqs)
+        for i, r in enumerate(reqs):
+            r.result = jax.tree.map(lambda x: x[i], host)
+            r.event.set()
+
+    # ---------------- stats / lifecycle ----------------
+
+    def latency_quantiles(self, qs=(0.5, 0.99)) -> dict[float, float]:
+        """Per-request latency quantiles (seconds), nearest-rank."""
+        with self._lock:
+            lat = sorted(self.latencies_s)
+        if not lat:
+            return {q: float("nan") for q in qs}
+        return {q: lat[min(len(lat) - 1, round(q * (len(lat) - 1)))] for q in qs}
+
+    def reset_stats(self):
+        with self._lock:
+            self.latencies_s.clear()
+            self.dispatch_log.clear()
+
+    def cache_size(self) -> int | None:
+        """Compiled-program count of the jitted entry point (None when the
+        infer fn does not expose jit cache introspection)."""
+        probe = getattr(self._infer, "_cache_size", None)
+        return probe() if callable(probe) else None
+
+    def close(self):
+        """Drain the queue, stop the worker, reject new submissions."""
+        with self._work:
+            self._closed = True
+            self._work.notify_all()
+            self._space.notify_all()
+        if self._worker is not None:
+            self._worker.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def make_dsac_serve_fn(c, cfg: RansacConfig = RansacConfig()):
+    """Jitted frames-major single-map (dsac) entry over a frame tree with
+    leaves ``key`` (typed PRNG), ``coords`` (N, 3), ``pixels`` (N, 2),
+    ``f`` (scalar focal).  One compile per bucket."""
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.ransac.kernel import dsac_infer_frames
+
+    c = jnp.asarray(c)
+
+    @jax.jit
+    def serve_dsac(batch):
+        return dsac_infer_frames(
+            batch["key"], batch["coords"], batch["pixels"], batch["f"], c, cfg
+        )
+
+    return serve_dsac
+
+
+def make_esac_serve_fn(c, cfg: RansacConfig = RansacConfig()):
+    """Jitted frames-major multi-expert (esac) entry over a frame tree with
+    leaves ``key``, ``gating_logits`` (M,), ``coords_all`` (M, N, 3),
+    ``pixels`` (N, 2), ``f``."""
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.ransac.esac import esac_infer_frames
+
+    c = jnp.asarray(c)
+
+    @jax.jit
+    def serve_esac(batch):
+        return esac_infer_frames(
+            batch["key"], batch["gating_logits"], batch["coords_all"],
+            batch["pixels"], batch["f"], c, cfg,
+        )
+
+    return serve_esac
+
+
+def make_sharded_serve_fn(mesh, c, cfg: RansacConfig = RansacConfig()):
+    """Jitted frames-major EXPERT-SHARDED entry (config #4's mesh) over a
+    frame tree with leaves ``key``, ``coords_all`` (M, N, 3), ``pixels``,
+    ``f`` — the same micro-batching front-end reused for the sharded path;
+    M must divide the mesh's expert axis."""
+    from esac_tpu.parallel.esac_sharded import make_esac_infer_sharded_frames
+
+    return make_esac_infer_sharded_frames(mesh, c, cfg, as_tree=True)

@@ -25,6 +25,19 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 
+def _tree_metadata(ckptr: "ocp.PyTreeCheckpointer", path: pathlib.Path) -> Any:
+    """Structure-only metadata of a saved PyTree checkpoint.
+
+    Orbax moved this surface across the version drift window: older
+    releases wrap it as ``CheckpointMetadata.item_metadata.tree``; the
+    shipping one returns the metadata tree from ``metadata()`` directly.
+    Accept both so checkpoints read on either side of the drift.
+    """
+    meta = ckptr.metadata(path)
+    item = getattr(meta, "item_metadata", meta)
+    return getattr(item, "tree", item)
+
+
 def save_checkpoint(path: str | pathlib.Path, params: Any, config: dict) -> None:
     path = pathlib.Path(path).absolute()
     path.mkdir(parents=True, exist_ok=True)
@@ -90,7 +103,7 @@ def load_train_state(path: str | pathlib.Path, opt_state_template: Any
     if not opt_dir.exists():
         raise FileNotFoundError(f"{opt_dir} (not a resume-capable checkpoint)")
     with ocp.PyTreeCheckpointer() as ckptr:
-        tree = ckptr.metadata(opt_dir).item_metadata.tree
+        tree = _tree_metadata(ckptr, opt_dir)
         restore_args = jax.tree.map(
             lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree
         )
@@ -125,7 +138,7 @@ def load_checkpoint(path: str | pathlib.Path) -> tuple[Any, dict]:
     Callers hand the tree to jit, which places it."""
     path = _with_old_fallback(path)
     with ocp.PyTreeCheckpointer() as ckptr:
-        tree = ckptr.metadata(path / "params").item_metadata.tree
+        tree = _tree_metadata(ckptr, path / "params")
         restore_args = jax.tree.map(
             lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree
         )

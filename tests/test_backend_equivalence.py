@@ -184,7 +184,11 @@ def test_train_gradient_parity_x64():
     from esac_tpu.backends import esac_train_cpp
     from esac_tpu.ransac import esac_train_loss
 
-    with jax.enable_x64(True):
+    # jax dropped the top-level enable_x64 alias in the drift window; the
+    # context manager lives under jax.experimental.
+    from jax.experimental import enable_x64
+
+    with enable_x64(True):
         co, px, idx, R_gt, t_gt = _train_fixture(
             0.01, 3, n_hyps=48, dtype=jnp.float64
         )

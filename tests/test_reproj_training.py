@@ -12,6 +12,7 @@ import pathlib
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from esac_tpu.data import CAMERA_F, make_correspondence_frame
 from esac_tpu.data.synthetic import output_pixel_grid
@@ -55,6 +56,10 @@ def test_reprojection_loss_zero_at_gt():
     assert jnp.all(jnp.isfinite(g)) and jnp.any(g != 0)
 
 
+# ~37s CLI training whose final checkpoint read needed the orbax metadata
+# fix (FAILURE at seed); too expensive for the 870s tier-1 budget on this
+# 1-core container — `pytest tests/` still runs it.
+@pytest.mark.slow
 def test_cli_reproj_mode_trains(tmp_path):
     """train_expert --loss reproj end-to-end on a synthetic scene (forcing
     the no-coords path); loss decreases and the checkpoint records the mode."""
@@ -114,6 +119,9 @@ def test_reprojection_loss_per_frame_focals():
     assert float(mixed) > float(uniform) + 1.0  # frame 1's focal mattered
 
 
+# ~33s; orbax-drift FAILURE at seed — same budget reasoning as
+# test_cli_reproj_mode_trains.
+@pytest.mark.slow
 def test_cli_auto_mode_on_diskscene_without_depth(tmp_path):
     """An on-disk scene with poses but NO depth/init (the Aachen layout
     after setup) auto-selects reprojection mode and trains."""
@@ -145,6 +153,9 @@ def test_cli_auto_mode_on_diskscene_without_depth(tmp_path):
     assert load_checkpoint(tmp_path / "ck")[1]["loss_mode"] == "reproj"
 
 
+# ~56s stop/resume; orbax-drift FAILURE at seed — same budget reasoning
+# as test_cli_reproj_mode_trains.
+@pytest.mark.slow
 def test_cli_reproj_resume_inside_bootstrap(tmp_path):
     """Stop during the heuristic-bootstrap phase and resume: the resumed
     process must rebuild the bootstrap targets (heur_d is allocated only

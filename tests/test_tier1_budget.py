@@ -1,0 +1,69 @@
+"""Tier-1 budget guards.
+
+The tier-1 gate (ROADMAP.md) runs `-m "not slow"` under a hard
+`timeout -k 10 870`; exceeding the budget kills the suite wholesale.  Two
+guards keep creep visible before that happens:
+
+- the most recent recorded tier-1 wall time (written by conftest's
+  sessionfinish hook) must be inside the budget;
+- heavy serving tests (``test_heavy_*``, the ISSUE-2 convention) must never
+  be collected into a tier-1 session — they belong to ``@pytest.mark.slow``.
+"""
+
+import json
+import pathlib
+import time
+
+import pytest
+
+# Same path conftest's sessionfinish hook writes (tests/ is not a package,
+# so recompute instead of importing conftest).
+TIER1_WALL_FILE = pathlib.Path(__file__).resolve().parent.parent / ".tier1_wall.json"
+
+TIER1_BUDGET_S = 870.0
+
+
+def test_last_recorded_tier1_wall_time_within_budget():
+    if not TIER1_WALL_FILE.exists():
+        pytest.skip("no recorded tier-1 run yet (first run records one)")
+    rec = json.loads(TIER1_WALL_FILE.read_text())
+    if time.time() - rec.get("t", 0) > 7 * 86400:
+        pytest.skip("recorded tier-1 run is stale (>7 days)")
+    assert rec["elapsed_s"] < TIER1_BUDGET_S, (
+        f"last tier-1 run took {rec['elapsed_s']}s — over the {TIER1_BUDGET_S}s "
+        "budget the driver kills at; move tests to @pytest.mark.slow"
+    )
+
+
+def test_tier1_never_collects_heavy_tests(request):
+    markexpr = getattr(request.config.option, "markexpr", "") or ""
+    if markexpr != "not slow":
+        pytest.skip("full (non-tier-1) run: heavy tests are allowed here")
+    heavy = [
+        item.nodeid
+        for item in request.session.items
+        if item.name.startswith("test_heavy_")
+    ]
+    assert heavy == [], (
+        f"heavy tests collected into the tier-1 gate: {heavy}; "
+        "mark them @pytest.mark.slow"
+    )
+
+
+def test_slow_marker_on_every_heavy_test():
+    """Static form of the same guard, so it also fires on full runs: every
+    ``test_heavy_*`` def in tests/ must sit under @pytest.mark.slow."""
+    tests_dir = pathlib.Path(__file__).resolve().parent
+    offenders = []
+    for path in sorted(tests_dir.glob("test_*.py")):
+        lines = path.read_text().splitlines()
+        for i, line in enumerate(lines):
+            if line.startswith("def test_heavy_"):
+                decorators = []
+                j = i - 1
+                while j >= 0 and (lines[j].startswith("@") or not lines[j].strip()):
+                    decorators.append(lines[j])
+                    j -= 1
+                if not any("pytest.mark.slow" in d for d in decorators):
+                    offenders.append(f"{path.name}:{i + 1}")
+    assert offenders == [], f"test_heavy_* without @pytest.mark.slow: {offenders}"
