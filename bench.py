@@ -56,10 +56,14 @@ C = (320.0, 240.0)
 PROBE_DEADLINE_S = 180      # backend init + tiny matmul; generous for a cold relay
 DEVICE_DEADLINE_S = 900     # first-compile can be slow; poll, never kill
 
+REGISTRY_SCENES = 3      # synthetic fleet size for the registry sweep
+REGISTRY_REPEATS = 7     # per-latency-class sample count (median + spread)
+
 _REPO = pathlib.Path(__file__).resolve().parent
 _PROBE_FILE = _REPO / ".tpu_probe.json"
 _RESULT_FILE = _REPO / ".bench_device.json"
 _SERVE_FILE = _REPO / ".serve_amortization.json"
+_REGISTRY_FILE = _REPO / ".registry_swap.json"
 
 
 def _measure_jax(
@@ -213,6 +217,172 @@ def _measure_serve(
     }
 
 
+def _measure_registry(
+    n_scenes: int = REGISTRY_SCENES,
+    repeats: int = REGISTRY_REPEATS,
+) -> dict:
+    """Multi-scene hot-swap latency classes (esac_tpu.registry; DESIGN.md
+    §10): a synthetic fleet of ``n_scenes`` scenes sharing one preset is
+    served through one scene-aware dispatcher, and each request-latency
+    class is sampled ``repeats`` times:
+
+    - ``compile_first_ms``  — very first request ever (checkpoint load +
+      device staging + the one jit compile the whole fleet shares);
+    - ``cold_load_ms``      — first request of each LATER scene (load +
+      staging, NO compile: the no-recompile property in wall-clock form);
+    - ``warm_hit_ms``       — repeat request, weights cached on device;
+    - ``hot_swap_ms``       — round-robin across all scenes, all cached
+      (a swap is a pure jit-argument change);
+    - ``evicted_reload_ms`` — cycling a fleet one scene larger than the
+      cache budget (every request re-stages its evicted weights: the
+      worst-case thrash floor).
+
+    The compile counter is recorded so the artifact itself proves the
+    swap legs never recompiled.
+    """
+    import shutil
+    import tempfile
+
+    root = pathlib.Path(tempfile.mkdtemp(prefix="esac_registry_bench_"))
+    try:
+        return _measure_registry_at(root, n_scenes, repeats)
+    finally:
+        # 2*n_scenes Orbax checkpoint trees: never leak them into /tmp.
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _measure_registry_at(root: pathlib.Path, n_scenes: int, repeats: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from esac_tpu.models import ExpertNet, GatingNet
+    from esac_tpu.ransac import RansacConfig
+    from esac_tpu.registry import (
+        SceneEntry, SceneManifest, ScenePreset, SceneRegistry, tree_nbytes,
+        load_scene_params,
+    )
+    from esac_tpu.utils.checkpoint import save_checkpoint
+
+    H = W = 32
+    M = 4
+    preset = ScenePreset(
+        height=H, width=W, num_experts=M,
+        stem_channels=(4, 8, 16), head_channels=16, head_depth=1,
+        gating_channels=(4,), compute_dtype="float32", gated=True,
+    )
+    cfg = RansacConfig(n_hyps=SERVE_HYPS, refine_iters=4, polish_iters=2,
+                       frame_buckets=(1,))
+
+    expert = ExpertNet(
+        scene_center=(0.0, 0.0, 0.0), stem_channels=preset.stem_channels,
+        head_channels=preset.head_channels, head_depth=preset.head_depth,
+        compute_dtype=jnp.float32,
+    )
+    gating = GatingNet(num_experts=M, channels=preset.gating_channels,
+                       compute_dtype=jnp.float32)
+    img0 = jnp.zeros((1, H, W, 3))
+
+    def write_scene(i):
+        e_params = jax.vmap(lambda k: expert.init(k, img0))(
+            jax.random.split(jax.random.key(i), M)
+        )
+        centers = (np.asarray([[0.0, 0.0, 2.0]], np.float32)
+                   + np.arange(M, dtype=np.float32)[:, None] * 0.1 + i * 0.01)
+        d = root / f"scene{i}"
+        save_checkpoint(d / "expert", e_params, {
+            "stem_channels": list(preset.stem_channels),
+            "head_channels": preset.head_channels,
+            "head_depth": preset.head_depth,
+            "scene_centers": centers.tolist(),
+            "f": 40.0, "c": [W / 2.0, H / 2.0],
+        })
+        save_checkpoint(d / "gating",
+                        gating.init(jax.random.key(1000 + i), img0),
+                        {"num_experts": M})
+        return SceneEntry(
+            scene_id=f"scene{i}", version=1,
+            expert_ckpt=str(d / "expert"), gating_ckpt=str(d / "gating"),
+            preset=preset, ransac=cfg,
+        )
+
+    manifest = SceneManifest()
+    entries = [manifest.add(write_scene(i)) for i in range(n_scenes)]
+    scene_nbytes = tree_nbytes(load_scene_params(entries[0]))
+
+    def frame(i):
+        return {
+            "key": jax.random.fold_in(jax.random.key(7), i),
+            "image": np.asarray(
+                jax.random.uniform(jax.random.fold_in(jax.random.key(42), i),
+                                   (H, W, 3))
+            ),
+        }
+
+    frames = [frame(i) for i in range(repeats)]
+
+    def timed(disp, fr, scene):
+        t0 = time.perf_counter()
+        disp.infer_one(fr, scene=scene)
+        return (time.perf_counter() - t0) * 1e3
+
+    def med(xs):
+        return sorted(xs)[len(xs) // 2]
+
+    registry = SceneRegistry(manifest)
+    disp = registry.dispatcher(cfg, start_worker=False)
+    sids = [e.scene_id for e in entries]
+
+    compile_first_ms = timed(disp, frames[0], sids[0])
+    cold_load = [timed(disp, frames[0], s) for s in sids[1:]]
+    # warm_hit PINS one scene (the dispatched params argument never
+    # changes); hot_swap cycles scenes every request — the delta between
+    # the two IS the cost of swapping weights.
+    warm_hit = [timed(disp, frames[i], sids[0]) for i in range(repeats)]
+    hot_swap = [timed(disp, frames[i], sids[(i + 1) % len(sids)])
+                for i in range(repeats)]
+    compiles_after_swaps = disp.cache_size()
+    stats_shared = registry.cache.stats()
+
+    # Thrash floor: a fresh registry whose budget holds all but one scene,
+    # cycled round-robin so EVERY request re-stages evicted weights.
+    thrash = SceneRegistry(
+        manifest, budget_bytes=scene_nbytes * (n_scenes - 1) + 1
+    )
+    disp_t = thrash.dispatcher(cfg, start_worker=False)
+    for s in sids:
+        disp_t.infer_one(frames[0], scene=s)  # fill + first evictions
+    evicted_reload = [timed(disp_t, frames[i], sids[i % len(sids)])
+                      for i in range(repeats)]
+
+    return {
+        "n_scenes": n_scenes,
+        "scene_nbytes": scene_nbytes,
+        "preset": {"hw": [H, W], "num_experts": M,
+                   "n_hyps": cfg.n_hyps, "frame_buckets": list(cfg.frame_buckets)},
+        "compile_first_ms": round(compile_first_ms, 2),
+        "cold_load_ms": round(med(cold_load), 2),
+        "cold_load_spread_ms": [round(x, 2) for x in sorted(cold_load)],
+        "warm_hit_ms": round(med(warm_hit), 2),
+        "warm_hit_spread_ms": [round(x, 2) for x in sorted(warm_hit)],
+        "hot_swap_ms": round(med(hot_swap), 2),
+        "hot_swap_spread_ms": [round(x, 2) for x in sorted(hot_swap)],
+        "evicted_reload_ms": round(med(evicted_reload), 2),
+        "evicted_reload_spread_ms": [round(x, 2) for x in sorted(evicted_reload)],
+        "compiled_programs_after_all_swaps": compiles_after_swaps,
+        "cache_stats_shared_registry": stats_shared,
+        "cold_over_warm_x": round(med(cold_load) / max(med(warm_hit), 1e-9), 2),
+        "swap_over_warm_x": round(med(hot_swap) / max(med(warm_hit), 1e-9), 2),
+        "note": (
+            "one preset shared by all scenes: compiled_programs_after_all_"
+            "swaps == len(frame_buckets) proves hot-swapping never "
+            "recompiles; hot_swap vs warm_hit isolates the cost of "
+            "changing the params argument; evicted_reload cycles a "
+            "budget one scene too small (worst-case thrash)"
+        ),
+    }
+
+
 def _measure_cpp() -> float | None:
     import jax
     import numpy as np
@@ -327,6 +497,8 @@ def device_child(kwargs: dict) -> None:
     kwargs = dict(kwargs)
     if kwargs.pop("serve", False):
         payload = {"serve": _measure_serve(**kwargs)}
+    elif kwargs.pop("registry", False):
+        payload = {"registry": _measure_registry(**kwargs)}
     else:
         payload = {"rate": _measure_jax(**kwargs)}
     import jax
@@ -582,6 +754,24 @@ def _pgid_cpu_only(pgid: int) -> bool:
         except Exception:
             continue
         found_any = True
+        # An EMPTY cmdline is a process caught between clone and execve
+        # (argv not installed yet — it may be about to become a non---cpu
+        # python) or a zombie.  The exec window is microseconds, so re-read
+        # briefly; a process that STAYS empty is unjudgeable and the
+        # invariant is "never stop a possible TPU-relay client": unknown
+        # means unpausable.  (Closes a real race: a group scanned while
+        # its python child was mid-exec used to read as CPU-only.)
+        for _ in range(5):
+            if cmd.strip():
+                break
+            time.sleep(0.01)
+            try:
+                cmd = (proc / "cmdline").read_bytes().decode().replace("\0", " ")
+            except Exception:
+                cmd = ""
+                break
+        if not cmd.strip():
+            return False
         if "python" in cmd.split(" ")[0] and "--cpu" not in cmd:
             return False
     return found_any
@@ -704,9 +894,61 @@ def _serve_main(stopped: list[int], load_before: list[float]) -> None:
     print(json.dumps(out))
 
 
+def _registry_main(stopped: list[int], load_before: list[float]) -> None:
+    """``python bench.py registry`` — multi-scene hot-swap latency classes
+    (DESIGN.md §10), wedge-safe like every other mode: the device leg runs
+    in a detached child (never killed), and on a wedged relay the sweep is
+    measured on the CPU backend, flagged via "note".  Records
+    .registry_swap.json with the same contention provenance."""
+    note = None
+    res = measure_on_device({"registry": True})
+    if res is None or "registry" not in res:
+        note = (
+            "device measurement unavailable (relay wedged or child failed); "
+            "registry sweep measured on CPU."
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        registry = _measure_registry()
+        platform, device_kind = "cpu", None
+    else:
+        registry = res["registry"]
+        platform, device_kind = res.get("platform"), res.get("device_kind")
+        if platform == "cpu":
+            note = "measurement child ran on CPU backend (no device visible)"
+    out = {
+        "metric": "registry_hot_swap_p50_ms",
+        "value": registry["hot_swap_ms"],
+        "unit": "ms",
+        "vs_baseline": None,
+        "vs_warm_hit": registry["swap_over_warm_x"],
+        "cold_over_warm_x": registry["cold_over_warm_x"],
+        "registry": registry,
+    }
+    if note:
+        out["note"] = note
+    if device_kind:
+        out["device_kind"] = device_kind
+    out["contention"] = _contention_block(stopped, load_before)
+    artifact = {
+        **out,
+        "platform": platform,
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+    }
+    tmp = str(_REGISTRY_FILE) + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(artifact, fh, indent=1)
+    os.replace(tmp, _REGISTRY_FILE)
+    print(json.dumps(out))
+
+
 def _main_measured(stopped: list[int], load_before: list[float]) -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "serve":
         _serve_main(stopped, load_before)
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "registry":
+        _registry_main(stopped, load_before)
         return
     streaming = len(sys.argv) > 1 and sys.argv[1] == "streaming"
     kwargs = (

@@ -205,6 +205,51 @@ def _build_esac_infer_frames():
     )(keys, coords_B)
 
 
+def _build_registry_scene_serve():
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.ransac.config import RansacConfig
+    from esac_tpu.registry.manifest import ScenePreset
+    from esac_tpu.registry.serving import make_scene_bucket_fn
+
+    H = W = 16
+    M, B = 2, 2
+    preset = ScenePreset(
+        height=H, width=W, num_experts=M,
+        stem_channels=(2, 2, 2), head_channels=2, head_depth=1,
+        gating_channels=(2,), compute_dtype="float32", gated=True,
+    )
+    cfg = RansacConfig(n_hyps=4, refine_iters=1, polish_iters=1)
+    fn = make_scene_bucket_fn(preset, cfg)
+
+    from esac_tpu.models.expert import ExpertNet
+    from esac_tpu.models.gating import GatingNet
+
+    expert = ExpertNet(scene_center=(0.0, 0.0, 0.0),
+                       stem_channels=preset.stem_channels,
+                       head_channels=preset.head_channels,
+                       head_depth=preset.head_depth,
+                       compute_dtype=jnp.float32)
+    gating = GatingNet(num_experts=M, channels=preset.gating_channels,
+                       compute_dtype=jnp.float32)
+    img = jnp.zeros((1, H, W, 3))
+    params = {
+        "expert": jax.vmap(lambda k: expert.init(k, img))(
+            jax.random.split(jax.random.key(0), M)
+        ),
+        "gating": gating.init(jax.random.key(1), img),
+        "centers": jnp.zeros((M, 3)),
+        "c": jnp.asarray([W / 2.0, H / 2.0]),
+        "f": jnp.float32(20.0),
+    }
+    batch = {
+        "key": jax.random.split(jax.random.key(2), B),
+        "image": jnp.zeros((B, H, W, 3)),
+    }
+    return jax.make_jaxpr(fn)(params, batch)
+
+
 def _build_sharded_train():
     import jax
 
@@ -269,6 +314,14 @@ ENTRIES: tuple[Entry, ...] = (
                "per dispatch, the DESIGN.md §9 amortization path"),
     Entry("esac_infer_frames", pinned=True, build=_build_esac_infer_frames,
           note="frames-major multi-expert serving dispatch"),
+    Entry("registry_scene_serve", pinned=False,
+          build=_build_registry_scene_serve,
+          note="multi-scene registry bucket program (esac_tpu.registry): "
+               "gating + expert CNNs + frames-major esac over weights "
+               "passed as jit ARGUMENTS; CNN compute is legitimately bf16 "
+               "in production presets so dot precision is not audited, but "
+               "primitives/static-shapes are — the hot-swap path must stay "
+               "scan/while-free and fixed-shape"),
     Entry("sharded_train_step", pinned=False, build=_build_sharded_train,
           note="EP+DP shard_map loss, forward only; CNN compute is "
                "legitimately bf16 so dot precision is not audited here"),

@@ -23,8 +23,8 @@ see PARALLELISM.md at the repo root for the explicit mapping.
 from esac_tpu.parallel.mesh import make_mesh, expert_sharding, batch_sharding
 from esac_tpu.parallel.esac_sharded import (
     esac_infer_routed, esac_infer_sharded, esac_infer_sharded_frames,
-    make_esac_infer_sharded_frames, pad_experts_for_mesh,
-    pad_gating_logits,
+    make_esac_infer_sharded_frames, make_esac_infer_sharded_frames_dynamic,
+    pad_experts_for_mesh, pad_gating_logits,
 )
 from esac_tpu.parallel.multihost import initialize_multihost
 from esac_tpu.parallel.train_sharded import make_sharded_esac_loss, shard_esac_params
@@ -38,6 +38,7 @@ __all__ = [
     "esac_infer_sharded_frames",
     "initialize_multihost",
     "make_esac_infer_sharded_frames",
+    "make_esac_infer_sharded_frames_dynamic",
     "make_sharded_esac_loss",
     "pad_experts_for_mesh",
     "pad_gating_logits",

@@ -139,32 +139,68 @@ def make_esac_infer_sharded_frames(
     one-argument callable over a frame-stacked tree (leaves ``key``,
     ``coords_all``, ``pixels``, ``f``) — the MicroBatchDispatcher contract
     (serve.make_sharded_serve_fn).
+
+    Implementation: binds ``c`` over the registry-backed
+    :func:`make_esac_infer_sharded_frames_dynamic` (c as a traced,
+    replicated argument), so the single-scene and multi-scene paths share
+    ONE shard_map body and cannot diverge.
+    """
+    infer_dyn = make_esac_infer_sharded_frames_dynamic(mesh, cfg)
+    c = jnp.asarray(c)
+
+    def infer_tree(batch):
+        return infer_dyn(batch, c)
+
+    infer_tree._cache_size = infer_dyn._cache_size
+
+    if as_tree:
+        return infer_tree
+
+    def infer(keys, coords_all, pixels, f):
+        return infer_tree({
+            "key": keys, "coords_all": coords_all, "pixels": pixels, "f": f,
+        })
+
+    return infer
+
+
+def make_esac_infer_sharded_frames_dynamic(
+    mesh: Mesh,
+    cfg: RansacConfig = RansacConfig(),
+):
+    """Registry-backed variant of :func:`make_esac_infer_sharded_frames`:
+    the principal point is a TRACED, replicated argument instead of a
+    closure constant, so ONE compiled program (per frame bucket) serves
+    every scene that shares shapes and ``cfg`` — hot-swapping a scene's
+    camera never recompiles (esac_tpu.registry wires the per-scene ``c``
+    from its device weight cache).  Returned callable:
+    ``fn(batch, c) -> dict`` with ``batch`` the frame-stacked tree of
+    :func:`make_esac_infer_sharded_frames` (leaves ``key``, ``coords_all``,
+    ``pixels``, ``f``) and ``c`` the (2,) principal point.
     """
     n_shards = mesh.shape["expert"]
-    c = jnp.asarray(c)
     specs = {
         "key": P(), "coords_all": P(None, "expert"), "pixels": P(), "f": P(),
     }
 
     @partial(
-        shard_map, mesh=mesh, in_specs=(specs,),
+        shard_map, mesh=mesh, in_specs=(specs, P()),
         out_specs=(P(), P(), P(), P()),
     )
-    def body(batch):
+    def body(batch, c):
         coords_local = batch["coords_all"]  # (B, m_local, N, 3)
         m_local = coords_local.shape[1]
         M = m_local * n_shards
         shard_id = jax.lax.axis_index("expert")
 
         def one_frame(k, coords_m, px, fi):
-            # Same key discipline as esac_infer_sharded: the score-subsample
-            # key splits BEFORE the per-shard fold so every shard scores on
-            # the same cell subset; only the hypothesis key is per-shard.
+            # Key discipline as in make_esac_infer_sharded_frames: the
+            # score-subsample key splits BEFORE the per-shard fold.
             k_hyp, k_sub = _split_score_key(k, cfg)
             k_local = jax.random.fold_in(k_hyp, shard_id)
             rvecs, tvecs, scores = _per_expert_hypotheses(
                 k_local, coords_m, px, fi, c, cfg, score_key=k_sub,
-            )  # (m_local, nh, 3), (m_local, nh)
+            )
             flat = jnp.argmax(scores.reshape(-1))
             mi, j = flat // scores.shape[1], flat % scores.shape[1]
             rvec, tvec = refine_soft_inliers(
@@ -179,24 +215,16 @@ def make_esac_infer_sharded_frames(
         return _winner_allreduce(local_score, g_expert, rvec, tvec, M)
 
     @jax.jit
-    def infer_tree(batch):
+    def infer_tree(batch, c):
         M = batch["coords_all"].shape[1]
         if M % n_shards != 0:
             raise ValueError(
                 f"M={M} not divisible by expert shards {n_shards}"
             )
-        rvec, tvec, expert, score = body(batch)
+        rvec, tvec, expert, score = body(batch, jnp.asarray(c))
         return {"rvec": rvec, "tvec": tvec, "expert": expert, "score": score}
 
-    if as_tree:
-        return infer_tree
-
-    def infer(keys, coords_all, pixels, f):
-        return infer_tree({
-            "key": keys, "coords_all": coords_all, "pixels": pixels, "f": f,
-        })
-
-    return infer
+    return infer_tree
 
 
 def esac_infer_sharded_frames(

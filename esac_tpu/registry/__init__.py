@@ -1,0 +1,43 @@
+"""Multi-scene model registry + device weight cache (hot-swap serving).
+
+ESAC's premise is many scenes split across expert networks; this package
+makes one serving process hold a *fleet* of scenes: a versioned
+:class:`SceneManifest` (which checkpoints serve which scene, with atomic
+promote/rollback), an LRU :class:`DeviceWeightCache` that pre-stages param
+trees on device under a byte budget, and :class:`SceneRegistry` serving
+fns whose weights are jit *arguments* bucketed by :class:`ScenePreset` —
+so swapping scenes never recompiles and never restages a cached scene.
+The scene-aware `serve.MicroBatchDispatcher` coalesces requests per
+(scene, frame-bucket) with round-robin fairness across scenes.
+"""
+
+from esac_tpu.registry.cache import DeviceWeightCache, tree_nbytes
+from esac_tpu.registry.manifest import (
+    ManifestError,
+    SceneEntry,
+    SceneManifest,
+    ScenePreset,
+    entry_from_dict,
+    entry_to_dict,
+)
+from esac_tpu.registry.serving import (
+    SceneRegistry,
+    load_scene_params,
+    make_registry_sharded_serve_fn,
+    make_scene_bucket_fn,
+)
+
+__all__ = [
+    "DeviceWeightCache",
+    "ManifestError",
+    "SceneEntry",
+    "SceneManifest",
+    "ScenePreset",
+    "SceneRegistry",
+    "entry_from_dict",
+    "entry_to_dict",
+    "load_scene_params",
+    "make_registry_sharded_serve_fn",
+    "make_scene_bucket_fn",
+    "tree_nbytes",
+]

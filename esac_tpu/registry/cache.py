@@ -1,0 +1,157 @@
+"""LRU device weight cache: pre-staged param trees under a byte budget.
+
+Serving many scenes from one process means many weight sets contending for
+one device's HBM.  This cache holds the device-resident param trees keyed
+by ``(scene_id, version)`` (``SceneEntry.key``): a hit returns the already
+device-put tree (zero staging cost on the request path), a miss pays
+``loader(entry)`` (host load via utils/checkpoint) + one ``device_put``,
+and eviction is deterministic strict-LRU under ``budget_bytes``.
+
+Invariants the serving layer relies on:
+
+- **Never donate cached params.**  The whole point of the cache is that a
+  tree is reused across dispatches; the jitted serve fns donate only the
+  per-dispatch batch tree (registry/serving.py).  Nothing here guards
+  against a caller donating a cached tree — it would invalidate the cached
+  buffers silently — so the rule is stated where the fns are built.
+- **Deterministic eviction.**  Strict LRU over ``get`` order, measured in
+  actual leaf bytes (``tree_nbytes``); the eviction order for a given
+  access sequence is reproducible, and ``evictions`` records it (pinned by
+  tests/test_registry.py).  The entry being inserted is never its own
+  eviction victim: a single scene larger than the budget is admitted alone
+  (a cache that cannot serve the requested scene is useless), with the
+  overshoot visible in ``bytes_in_use``.
+- **Resolution happens at dispatch time.**  The cache is keyed by version,
+  so a manifest promote simply starts missing on the new key; the old
+  version's tree ages out by LRU — in-flight dispatches that already hold
+  the old tree keep a Python reference, so eviction can never free buffers
+  under a running computation.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from collections.abc import Callable
+from typing import Any
+
+
+def tree_nbytes(tree: Any) -> int:
+    """Total leaf bytes of a (host or device) array pytree."""
+    import jax
+
+    return sum(
+        leaf.nbytes for leaf in jax.tree.leaves(tree)
+        if hasattr(leaf, "nbytes")
+    )
+
+
+class DeviceWeightCache:
+    """Strict-LRU (scene, version) -> device param tree, byte-budgeted.
+
+    ``loader(entry) -> host tree`` produces the weights (numpy leaves;
+    registry/serving.load_scene_params is the shipped loader);
+    ``budget_bytes=None`` disables eviction (everything stays resident).
+    Thread-safe: one lock covers lookup, load, staging and eviction, so
+    concurrent dispatch workers cannot double-load a scene.
+    """
+
+    def __init__(
+        self,
+        loader: Callable[[Any], Any],
+        budget_bytes: int | None = None,
+        device=None,
+    ):
+        if budget_bytes is not None and budget_bytes <= 0:
+            raise ValueError(f"budget_bytes {budget_bytes} must be positive")
+        self._loader = loader
+        self._budget = budget_bytes
+        self._device = device
+        self._lock = threading.Lock()
+        self._trees: "collections.OrderedDict[Any, Any]" = collections.OrderedDict()
+        self._nbytes: dict[Any, int] = {}
+        self.hits = 0
+        self.misses = 0
+        # Bounded like the dispatcher's stats deques: a thrashing server
+        # evicts per request for days — the recent window is the record,
+        # the counter is the total.
+        self.evictions: collections.deque = collections.deque(maxlen=10_000)
+        self.evictions_total = 0
+
+    # ---- the request path ----
+
+    def get(self, entry) -> Any:
+        """Device param tree for ``entry`` (anything with a ``.key``); loads
+        and stages on miss, evicting LRU entries until the budget holds."""
+        import jax
+
+        key = entry.key
+        with self._lock:
+            if key in self._trees:
+                self.hits += 1
+                self._trees.move_to_end(key)
+                return self._trees[key]
+            self.misses += 1
+            host = self._loader(entry)
+            tree = (
+                jax.device_put(host, self._device)
+                if self._device is not None else jax.device_put(host)
+            )
+            self._trees[key] = tree
+            self._nbytes[key] = tree_nbytes(tree)
+            self._evict_to_budget()
+            return tree
+
+    def _evict_to_budget(self) -> None:
+        if self._budget is None:
+            return
+        while len(self._trees) > 1 and self.bytes_in_use > self._budget:
+            victim, _ = self._trees.popitem(last=False)
+            del self._nbytes[victim]
+            self.evictions.append(victim)
+            self.evictions_total += 1
+
+    # ---- introspection / management ----
+
+    @property
+    def bytes_in_use(self) -> int:
+        return sum(self._nbytes.values())
+
+    def keys(self) -> list[Any]:
+        """Resident keys, least-recently-used first (the eviction order)."""
+        with self._lock:
+            return list(self._trees)
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._trees
+
+    def __len__(self) -> int:
+        return len(self._trees)
+
+    def evict(self, key) -> bool:
+        """Drop one entry (e.g. a rolled-back version); True if resident."""
+        with self._lock:
+            if key not in self._trees:
+                return False
+            del self._trees[key]
+            del self._nbytes[key]
+            self.evictions.append(key)
+            self.evictions_total += 1
+            return True
+
+    def clear(self) -> None:
+        with self._lock:
+            self._trees.clear()
+            self._nbytes.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions_total,
+                "resident": len(self._trees),
+                "bytes_in_use": self.bytes_in_use,
+                "budget_bytes": self._budget,
+            }

@@ -1,0 +1,260 @@
+"""Scene-aware serving: weights as jit ARGUMENTS, programs keyed by preset.
+
+The PR-2 serving path (`esac_tpu/serve/`) bakes one scene's camera and
+weights into the jitted closure — a second scene meant a second process.
+This module inverts that: one jitted program per *bucket key*
+(:meth:`SceneEntry.bucket_key` = (ScenePreset, RansacConfig)), with every
+per-scene quantity — expert/gating weights, per-expert scene centers,
+principal point, focal — riding the **device param tree** as traced
+arguments.  Swapping scenes inside a bucket is therefore a pure
+argument change: zero recompiles (pinned by the jit cache-miss counter in
+tests/test_registry.py), and with the tree pre-staged by the
+:class:`~esac_tpu.registry.cache.DeviceWeightCache`, zero staging cost on
+the hot path.
+
+Donation policy: the per-dispatch ``batch`` tree is donated on
+accelerators (its buffers are dead once the dispatch returns — the
+staging double-buffer never reuses them); the ``params`` tree is NEVER
+donated, because the weight cache hands the same buffers to every
+subsequent dispatch of that scene.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from esac_tpu.ransac.config import RansacConfig
+from esac_tpu.registry.cache import DeviceWeightCache
+from esac_tpu.registry.manifest import (
+    ManifestError,
+    SceneEntry,
+    SceneManifest,
+    ScenePreset,
+)
+from esac_tpu.utils.checkpoint import load_checkpoint
+
+
+def load_scene_params(entry: SceneEntry) -> dict:
+    """Default weight-cache loader: checkpoint dirs -> one host param tree.
+
+    Reads the expert (and, for gated presets, gating) checkpoints through
+    ``utils/checkpoint.load_checkpoint`` (host numpy — the writer's device
+    sharding must not leak into the serving topology) and validates the
+    checkpoint's config sidecar against the manifest preset: a manifest
+    that points a preset at weights of a different architecture must fail
+    at LOAD time with a precise error, not at dispatch time with a shape
+    mismatch deep inside jit.
+
+    The tree's leaves: ``expert`` (M-stacked variables), ``gating`` (gated
+    presets only), ``centers`` (M, 3) per-expert scene centers, ``c`` (2,)
+    principal point, ``f`` () focal — everything a bucket fn needs beyond
+    the request itself.
+    """
+    p = entry.preset
+    params_e, cfg_e = load_checkpoint(entry.expert_ckpt)
+    what = f"{entry.scene_id} v{entry.version}"
+    for field in ("stem_channels", "head_channels", "head_depth"):
+        want = getattr(p, field)
+        got = cfg_e.get(field)
+        got = tuple(got) if isinstance(got, list) else got
+        if got != want:
+            raise ManifestError(
+                f"{what}: expert checkpoint {field}={got!r} but the "
+                f"manifest preset says {want!r}"
+            )
+    for field in ("scene_centers", "f", "c"):
+        if field not in cfg_e:
+            raise ManifestError(
+                f"{what}: expert checkpoint config lacks {field!r} "
+                "(not a registry-servable checkpoint)"
+            )
+    centers = np.asarray(cfg_e["scene_centers"], np.float32)
+    if centers.shape != (p.num_experts, 3):
+        raise ManifestError(
+            f"{what}: scene_centers shape {centers.shape} != "
+            f"({p.num_experts}, 3)"
+        )
+    leaves = [x for x in _tree_leaves(params_e) if hasattr(x, "shape")]
+    if leaves and leaves[0].shape[0] != p.num_experts:
+        raise ManifestError(
+            f"{what}: expert params leading axis {leaves[0].shape[0]} != "
+            f"preset num_experts {p.num_experts} (experts must be stacked)"
+        )
+    tree = {
+        "expert": params_e,
+        "centers": centers,
+        "c": np.asarray(cfg_e["c"], np.float32).reshape(2),
+        "f": np.float32(cfg_e["f"]),
+    }
+    if p.gated:
+        params_g, cfg_g = load_checkpoint(entry.gating_ckpt)
+        if int(cfg_g.get("num_experts", -1)) != p.num_experts:
+            raise ManifestError(
+                f"{what}: gating checkpoint num_experts="
+                f"{cfg_g.get('num_experts')!r} != preset {p.num_experts}"
+            )
+        tree["gating"] = params_g
+    return tree
+
+
+def _tree_leaves(tree):
+    import jax
+
+    return jax.tree.leaves(tree)
+
+
+def make_scene_bucket_fn(preset: ScenePreset, cfg: RansacConfig):
+    """One jitted full-pipeline program for a (preset, cfg) bucket.
+
+    ``fn(params, batch) -> result tree``: ``batch`` is a frame-stacked
+    tree with leaves ``key`` (B,) typed PRNG keys and ``image``
+    (B, H, W, 3); ``params`` is a :func:`load_scene_params`-shaped device
+    tree.  Pipeline: gating CNN (or zero logits for ungated presets) ->
+    all M expert CNNs -> frames-major multi-expert RANSAC
+    (``esac_infer_frames``), every per-scene number a traced argument.
+    One program compiles per frame bucket, shared by every scene in the
+    bucket (the no-recompile property).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.data.synthetic import output_pixel_grid
+    from esac_tpu.models.expert import ExpertNet
+    from esac_tpu.models.gating import GatingNet
+    from esac_tpu.ransac.esac import esac_infer_frames
+
+    dtype = jnp.bfloat16 if preset.compute_dtype == "bfloat16" else jnp.float32
+    expert = ExpertNet(
+        scene_center=(0.0, 0.0, 0.0),  # real centers ride params["centers"]
+        stem_channels=preset.stem_channels,
+        head_channels=preset.head_channels,
+        head_depth=preset.head_depth,
+        compute_dtype=dtype,
+    )
+    gating = GatingNet(
+        num_experts=preset.num_experts,
+        channels=preset.gating_channels,
+        compute_dtype=dtype,
+    ) if preset.gated else None
+    pixels = output_pixel_grid(preset.height, preset.width, preset.stride)
+
+    def run(params, batch):
+        imgs = batch["image"]
+        B = imgs.shape[0]
+        # (M, B, h, w, 3): each stacked expert's CNN over the whole batch.
+        coords = jax.vmap(lambda pe: expert.apply(pe, imgs))(params["expert"])
+        coords = jnp.moveaxis(coords, 0, 1).reshape(
+            B, preset.num_experts, -1, 3
+        ) + params["centers"][None, :, None, :]
+        if gating is not None:
+            logits = gating.apply(params["gating"], imgs)  # (B, M)
+        else:
+            logits = jnp.zeros((B, preset.num_experts), jnp.float32)
+        f_b = jnp.broadcast_to(
+            jnp.asarray(params["f"], jnp.float32), (B,)
+        )
+        px_b = jnp.broadcast_to(pixels[None], (B,) + pixels.shape)
+        return esac_infer_frames(
+            batch["key"], logits, coords, px_b, f_b, params["c"], cfg
+        )
+
+    # Donate the batch (dead after the dispatch); NEVER the cached params.
+    # CPU ignores donation with a warning, so only accelerators opt in.
+    donate = (1,) if jax.default_backend() != "cpu" else ()
+    return jax.jit(run, donate_argnums=donate)
+
+
+class SceneRegistry:
+    """Manifest + device weight cache + per-bucket compiled programs.
+
+    The serving facade: ``infer_fn()`` yields the scene-aware callable the
+    :class:`~esac_tpu.serve.MicroBatchDispatcher` drives (``fn(batch,
+    scene)``), resolving the scene's ACTIVE manifest entry and cached
+    device weights **per dispatch** — which is exactly what gives
+    promote/rollback their drain semantics: a dispatch in flight keeps the
+    entry and params it resolved; the next dispatch sees the new pointer.
+    """
+
+    def __init__(
+        self,
+        manifest: SceneManifest,
+        budget_bytes: int | None = None,
+        loader=load_scene_params,
+        device=None,
+    ):
+        self.manifest = manifest
+        self.cache = DeviceWeightCache(loader, budget_bytes, device)
+        self._fns: dict = {}
+        self._fns_lock = threading.Lock()
+
+    def _fn_for(self, entry: SceneEntry):
+        key = entry.bucket_key()
+        with self._fns_lock:
+            fn = self._fns.get(key)
+            if fn is None:
+                fn = make_scene_bucket_fn(entry.preset, entry.ransac)
+                self._fns[key] = fn
+            return fn
+
+    def infer_fn(self):
+        """The dispatcher-facing callable: ``fn(batch, scene)``."""
+
+        def serve(batch, scene):
+            entry = self.manifest.resolve(scene)
+            params = self.cache.get(entry)
+            return self._fn_for(entry)(params, batch)
+
+        serve._cache_size = self.compile_cache_size
+        return serve
+
+    def compile_cache_size(self) -> int:
+        """Total compiled programs across every bucket fn — the cache-miss
+        counter the no-recompile acceptance test pins (must equal
+        buckets-used x bucket-keys, however many scenes were swapped)."""
+        with self._fns_lock:
+            fns = list(self._fns.values())
+        return sum(fn._cache_size() for fn in fns)
+
+    def warm(self, scene_id: str) -> None:
+        """Pre-stage a scene's active weights (cold-load off the hot path)."""
+        self.cache.get(self.manifest.resolve(scene_id))
+
+    def dispatcher(self, cfg: RansacConfig = RansacConfig(),
+                   start_worker: bool = True, **kw):
+        """A scene-aware MicroBatchDispatcher over this registry.  ``cfg``
+        carries the SERVING knobs (frame buckets, wait, depth) — each
+        scene's kernel still runs under its own manifest RansacConfig."""
+        from esac_tpu.serve import MicroBatchDispatcher
+
+        return MicroBatchDispatcher(
+            self.infer_fn(), cfg, start_worker=start_worker, **kw
+        )
+
+
+def make_registry_sharded_serve_fn(
+    mesh, registry: SceneRegistry, cfg: RansacConfig = RansacConfig()
+):
+    """Registry-backed variant of ``serve.make_sharded_serve_fn``: the
+    expert-sharded frames-major path with the scene's principal point
+    resolved from the registry per dispatch and passed as a traced
+    argument (``parallel.make_esac_infer_sharded_frames_dynamic``), so one
+    sharded program serves every scene that shares shapes and ``cfg``.
+    The batch tree is the coords-level sharded contract (``key``,
+    ``coords_all``, ``pixels``, ``f``) — expert CNNs run upstream on the
+    expert-parallel mesh; what hot-swaps here is the scene's camera.
+    """
+    from esac_tpu.parallel.esac_sharded import (
+        make_esac_infer_sharded_frames_dynamic,
+    )
+
+    infer = make_esac_infer_sharded_frames_dynamic(mesh, cfg)
+
+    def serve(batch, scene):
+        entry = registry.manifest.resolve(scene)
+        params = registry.cache.get(entry)
+        return infer(batch, params["c"])
+
+    serve._cache_size = infer._cache_size
+    return serve

@@ -22,6 +22,15 @@ are below (``make_dsac_serve_fn``, ``make_esac_serve_fn``,
 ``make_sharded_serve_fn``); each is a single ``jax.jit`` callable so one
 program compiles per bucket and the compile count is observable
 (``cache_size``, pinned by tests/test_serve.py).
+
+Multi-scene serving (esac_tpu.registry): every request may carry a
+``scene`` key.  Requests coalesce per (scene, frame-bucket) — a dispatch
+is always single-scene, because the scene decides which weights ride the
+program — and the worker round-robins across scenes with pending work, so
+a hot scene cannot starve a cold one.  Scene-carrying dispatches call
+``infer_fn(tree, scene)`` (the registry's serve fn resolves weights from
+its device cache per dispatch); scene-less requests keep the original
+``infer_fn(tree)`` contract, byte-for-byte.
 """
 
 from __future__ import annotations
@@ -40,10 +49,11 @@ from esac_tpu.serve.batching import (
 
 
 class _Request:
-    __slots__ = ("frame", "event", "result", "error", "t_submit")
+    __slots__ = ("frame", "scene", "event", "result", "error", "t_submit")
 
-    def __init__(self, frame, t_submit):
+    def __init__(self, frame, t_submit, scene=None):
         self.frame = frame
+        self.scene = scene
         self.event = threading.Event()
         self.result = None
         self.error = None
@@ -76,7 +86,12 @@ class MicroBatchDispatcher:
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)   # waiters: worker
         self._space = threading.Condition(self._lock)  # waiters: submitters
-        self._pending: collections.deque[_Request] = collections.deque()
+        # Per-scene queues in round-robin order (scene None = the legacy
+        # single-scene mode); a dispatch never mixes scenes.
+        self._pending: "collections.OrderedDict[object, collections.deque[_Request]]" = (
+            collections.OrderedDict()
+        )
+        self._n_pending = 0
         self._closed = False
         # Bounded stats: a serving process runs for days — unbounded lists
         # would leak and latency_quantiles() would sort the whole history
@@ -87,6 +102,9 @@ class MicroBatchDispatcher:
         self.dispatch_log: collections.deque[tuple[int, int]] = (
             collections.deque(maxlen=10_000)  # (bucket, n_valid)
         )
+        # Scene of each dispatch, aligned with dispatch_log (None entries
+        # for scene-less traffic) — the fairness tests zip the two.
+        self.scene_log: collections.deque = collections.deque(maxlen=10_000)
         self._worker = None
         if start_worker:
             self.start()
@@ -105,33 +123,38 @@ class MicroBatchDispatcher:
 
     # ---------------- request path ----------------
 
-    def submit(self, frame: dict) -> _Request:
-        """Enqueue one frame tree; returns a request whose ``event`` fires
-        when ``result`` (or ``error``) is set.  Blocks for queue space —
-        backpressure, never drops."""
-        req = _Request(frame, self._clock())
+    def submit(self, frame: dict, scene=None) -> _Request:
+        """Enqueue one frame tree (optionally for a registry ``scene``);
+        returns a request whose ``event`` fires when ``result`` (or
+        ``error``) is set.  Blocks for queue space — backpressure across
+        ALL scenes, never drops."""
+        req = _Request(frame, self._clock(), scene)
         with self._work:
-            while len(self._pending) >= self._depth and not self._closed:
+            while self._n_pending >= self._depth and not self._closed:
                 self._space.wait()
             if self._closed:
                 raise RuntimeError("dispatcher is closed")
-            self._pending.append(req)
+            q = self._pending.get(scene)
+            if q is None:
+                q = self._pending[scene] = collections.deque()
+            q.append(req)
+            self._n_pending += 1
             self._work.notify()
         return req
 
-    def infer_one(self, frame: dict) -> dict:
+    def infer_one(self, frame: dict, scene=None) -> dict:
         """Blocking single-frame inference through the batching queue."""
         if self._worker is None:
-            req = _Request(frame, self._clock())
-            self._run([req])
+            req = _Request(frame, self._clock(), scene)
+            self._run([req], scene)
         else:
-            req = self.submit(frame)
+            req = self.submit(frame, scene)
             req.event.wait()
         if req.error is not None:
             raise req.error
         return req.result
 
-    def infer_many(self, frames: list[dict]) -> list[dict]:
+    def infer_many(self, frames: list[dict], scene=None) -> list[dict]:
         """Bulk inference: bucket-planned dispatches, staging double-buffered
         against in-flight compute.  Returns per-frame result trees (host
         numpy), in input order."""
@@ -156,7 +179,7 @@ class MicroBatchDispatcher:
         staged = stage(*bounds[0])
         for i in range(len(bounds)):
             tree, n_valid = staged
-            out = self._infer(tree)  # async dispatch: device compute starts
+            out = self._call(tree, scene)  # async dispatch: compute starts
             if i + 1 < len(bounds):
                 staged = stage(*bounds[i + 1])  # host staging overlaps compute
             out = jax.block_until_ready(out)
@@ -166,6 +189,7 @@ class MicroBatchDispatcher:
                 self.dispatch_log.append(
                     (pick_bucket(n_valid, self._buckets), n_valid)
                 )
+                self.scene_log.append(scene)
                 self.latencies_s.extend([t_done - t_submit] * n_valid)
             results.extend(
                 jax.tree.map(lambda x: x[j], host) for j in range(n_valid)
@@ -174,16 +198,28 @@ class MicroBatchDispatcher:
 
     # ---------------- worker ----------------
 
+    def _call(self, tree, scene):
+        """Invoke the entry point: scene-carrying dispatches pass the scene
+        through (registry serve fns take ``(tree, scene)``); legacy
+        traffic keeps the one-argument contract."""
+        if scene is None:
+            return self._infer(tree)
+        return self._infer(tree, scene)
+
     def _worker_loop(self):
         big = self._buckets[-1]
         while True:
             with self._work:
-                while not self._pending and not self._closed:
+                while not self._n_pending and not self._closed:
                     self._work.wait()
-                if not self._pending:
+                if not self._n_pending:
                     return  # closed and drained
-                deadline = self._pending[0].t_submit + self._max_wait_s
-                while len(self._pending) < big and not self._closed:
+                # Fairness: serve the scene at the head of the round-robin
+                # order; if it still has pending work afterwards it moves to
+                # the back, so a flooding scene cannot starve the others.
+                scene, q = next(iter(self._pending.items()))
+                deadline = q[0].t_submit + self._max_wait_s
+                while len(q) < big and not self._closed:
                     remaining = deadline - self._clock()
                     if remaining <= 0:
                         break
@@ -191,22 +227,25 @@ class MicroBatchDispatcher:
                 # serve_max_wait_ms == 0 means coalescing is OFF: exactly one
                 # request per dispatch (per-frame-call semantics), even when
                 # a burst is already queued.
-                take = 1 if self._max_wait_s == 0 else min(
-                    len(self._pending), big
-                )
-                batch = [self._pending.popleft() for _ in range(take)]
+                take = 1 if self._max_wait_s == 0 else min(len(q), big)
+                batch = [q.popleft() for _ in range(take)]
+                self._n_pending -= take
+                if q:
+                    self._pending.move_to_end(scene)
+                else:
+                    del self._pending[scene]
                 self._space.notify_all()
-            self._run(batch)
+            self._run(batch, scene)
 
-    def _run(self, reqs: list[_Request]):
+    def _run(self, reqs: list[_Request], scene=None):
         try:
-            self._dispatch(reqs)
+            self._dispatch(reqs, scene)
         except Exception as e:  # noqa: BLE001 — fan the failure out
             for r in reqs:
                 r.error = e
                 r.event.set()
 
-    def _dispatch(self, reqs: list[_Request]):
+    def _dispatch(self, reqs: list[_Request], scene=None):
         import jax
         import numpy as np
 
@@ -214,12 +253,13 @@ class MicroBatchDispatcher:
         padded, n_valid = pad_batch(
             stack_frames([r.frame for r in reqs]), bucket
         )
-        out = self._infer(jax.device_put(padded))
+        out = self._call(jax.device_put(padded), scene)
         out = jax.block_until_ready(out)
         t_done = self._clock()
         host = jax.tree.map(np.asarray, out)
         with self._lock:
             self.dispatch_log.append((bucket, n_valid))
+            self.scene_log.append(scene)
             self.latencies_s.extend(t_done - r.t_submit for r in reqs)
         for i, r in enumerate(reqs):
             r.result = jax.tree.map(lambda x: x[i], host)
@@ -239,6 +279,7 @@ class MicroBatchDispatcher:
         with self._lock:
             self.latencies_s.clear()
             self.dispatch_log.clear()
+            self.scene_log.clear()
 
     def cache_size(self) -> int | None:
         """Compiled-program count of the jitted entry point (None when the

@@ -131,6 +131,33 @@ def _with_old_fallback(path: str | pathlib.Path) -> pathlib.Path:
     return path
 
 
+def checkpoint_nbytes(path: str | pathlib.Path) -> int:
+    """Total parameter bytes of ``<path>/params`` from Orbax METADATA alone
+    — no array data is read.
+
+    The operator-side sizing tool for the registry's device weight cache
+    (esac_tpu.registry): budget a fleet's ``budget_bytes`` against its
+    checkpoints without restoring any of them.  (The cache itself measures
+    actual staged bytes post-``device_put`` — authoritative, but only
+    after a load; this is the plan-ahead view, equal to the staged size
+    for numpy-restored trees, pinned in tests/test_registry.py.)  Falls
+    back to a full host restore when a metadata leaf carries no
+    shape/dtype (older Orbax layouts).
+    """
+    path = _with_old_fallback(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        tree = _tree_metadata(ckptr, path / "params")
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            params, _ = load_checkpoint(path)
+            return sum(x.nbytes for x in jax.tree.leaves(params))
+        total += int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    return total
+
+
 def load_checkpoint(path: str | pathlib.Path) -> tuple[Any, dict]:
     """Restore as HOST numpy arrays: checkpoints written on one topology
     (e.g. the TPU) must load on any other (e.g. the CPU test mesh) — the

@@ -312,6 +312,36 @@ def test_pause_pipelines_skips_group_with_non_cpu_python(tmp_path, monkeypatch):
         child.wait()
 
 
+def test_pause_pipelines_skips_group_with_unjudgeable_cmdline(tmp_path, monkeypatch):
+    """Regression (PR 3): a process whose /proc cmdline STAYS empty — a
+    zombie here; the same read a child gives between clone and execve —
+    cannot be judged CPU-only, and an about-to-exec child may become a
+    non---cpu python, so bench must refuse to pause the group.  Before the
+    fix, an empty cmdline was invisible to the python-without---cpu check
+    and the group was judged pausable."""
+    import subprocess
+
+    monkeypatch.setattr(bench, "_REPO", tmp_path)
+    monkeypatch.setattr(bench, "_orphan_trainer_pgids", lambda: set())
+    child = subprocess.Popen(
+        ["sleep", "0"], start_new_session=True,
+        stdin=subprocess.DEVNULL, stdout=subprocess.DEVNULL,
+    )
+    try:
+        # Let it exit WITHOUT reaping: the zombie keeps its pid/pgid but
+        # its cmdline reads empty forever — the permanently-unjudgeable
+        # case (also exercises _pgid_cpu_only's re-read grace loop).
+        deadline = time.time() + 10
+        while _proc_state(child.pid) != "Z" and time.time() < deadline:
+            time.sleep(0.01)
+        assert _proc_state(child.pid) == "Z"
+        (tmp_path / ".pipeline.pid").write_text(f"{child.pid}\n")
+        stopped, _ = bench._pause_pipelines()
+        assert stopped == []
+    finally:
+        child.wait()
+
+
 def test_breadcrumb_dead_owner_resumed_and_cleaned(tmp_path, monkeypatch):
     """ADVICE r4: a bench SIGKILLed mid-pause must not freeze the queues
     forever — the next invocation resumes pgids from the breadcrumb."""

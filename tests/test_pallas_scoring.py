@@ -73,7 +73,19 @@ def test_pallas_dispatch_through_dsac_infer():
 
 def test_pallas_grad_matches_xla_reference():
     """The custom_vjp backward must equal jax.grad of the XLA scoring path
-    for every differentiable input (the decisive training-parity check)."""
+    for every differentiable input (the decisive training-parity check).
+
+    Tolerance rationale (root-caused 2026-08): both f32 backwards sit
+    EQUALLY far from an f64 oracle of the same math — on this fixture the
+    custom_vjp's max-abs distance to f64 is 0.24 vs plain-autodiff's 0.31,
+    and the single worst pallas-vs-xla element brackets the f64 value
+    (-23.93 / -23.78 around -23.84).  The divergence is f32 rounding
+    through a signed sum over 300 sigmoid'd cells (partial cancellation via
+    the random cotangent), not a backward-math bug, so the decisive
+    assertion is distance-to-f64 parity: the analytic VJP may be no worse
+    than 2x autodiff's own f32 conditioning error per input.  A direct
+    f32-vs-f32 allclose rides along at the measured conditioning envelope
+    (0.7% rel / 0.16 abs observed; 2x headroom)."""
     frame = make_correspondence_frame(
         jax.random.key(7), noise=0.02, outlier_frac=0.3, **FRAME_KW
     )
@@ -89,19 +101,38 @@ def test_pallas_grad_matches_xla_reference():
                                       F, C, 10.0, 0.5, interpret=True)
         return jnp.sum(s * cot)
 
-    def loss_xla(Rs_, ts_, coords_):
-        from esac_tpu.geometry.camera import reprojection_errors
+    def make_loss_xla(pixels, f, c, cot_):
+        def loss_xla(Rs_, ts_, coords_):
+            from esac_tpu.geometry.camera import reprojection_errors
 
-        errs = jax.vmap(
-            lambda R, t: reprojection_errors(R, t, coords_, frame["pixels"], F, C)
-        )(Rs_, ts_)
-        return jnp.sum(soft_inlier_score(errs, 10.0, 0.5) * cot)
+            errs = jax.vmap(
+                lambda R, t: reprojection_errors(R, t, coords_, pixels, f, c)
+            )(Rs_, ts_)
+            return jnp.sum(soft_inlier_score(errs, 10.0, 0.5) * cot_)
+        return loss_xla
 
     gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(Rs, tvecs, frame["coords"])
-    gx = jax.grad(loss_xla, argnums=(0, 1, 2))(Rs, tvecs, frame["coords"])
-    for a, b in zip(gp, gx):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-3, atol=2e-4)
+    gx = jax.grad(make_loss_xla(frame["pixels"], F, C, cot),
+                  argnums=(0, 1, 2))(Rs, tvecs, frame["coords"])
+
+    # f64 oracle of the identical XLA math: the truth both f32 paths chase.
+    from jax.experimental import enable_x64
+
+    with enable_x64(True):
+        as64 = lambda x: jnp.asarray(np.asarray(x), jnp.float64)  # noqa: E731
+        g64 = jax.grad(
+            make_loss_xla(as64(frame["pixels"]), jnp.float64(float(F)),
+                          as64(C), as64(cot)),
+            argnums=(0, 1, 2),
+        )(as64(Rs), as64(tvecs), as64(frame["coords"]))
+
+    for a, b, o in zip(gp, gx, g64):
+        a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+        o = np.asarray(o)
+        # custom_vjp no farther from f64 truth than 2x plain autodiff's own
+        # f32 error (+1e-3 slack for the degenerate zero-error case).
+        assert np.abs(a - o).max() <= 2.0 * np.abs(b - o).max() + 1e-3
+        np.testing.assert_allclose(a, b, rtol=2e-2, atol=0.4)
 
 
 def test_pallas_training_grad_end_to_end():

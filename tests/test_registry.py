@@ -1,0 +1,444 @@
+"""Multi-scene registry tests (esac_tpu.registry; ISSUE 4).
+
+The load-bearing claims:
+
+- the manifest round-trips and REJECTS every malformed shape (a serving
+  control-plane document must fail loudly);
+- the device weight cache evicts strict-LRU under a byte budget, in a
+  deterministic, recorded order;
+- inference for the same request is BIT-identical across cold-load,
+  warm-hit and post-swap (weights re-staged after eviction), and across a
+  multi-scene server vs a fresh single-scene server;
+- two scenes dispatched through one ``MicroBatchDispatcher`` coalesce per
+  (scene, bucket) with round-robin fairness, and the whole traffic
+  compiles each (bucket-key, frame-bucket) program exactly once — the jit
+  cache-miss counter proves hot-swapping never recompiles;
+- manifest promote/rollback atomically switch which weights serve a scene.
+
+Everything runs tiny (16x16 frames, 2x 2-channel experts, 8 hypotheses):
+these tests pin plumbing invariants, not accuracy.
+"""
+
+import dataclasses
+import json
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esac_tpu.models import ExpertNet, GatingNet
+from esac_tpu.ransac import RansacConfig
+from esac_tpu.registry import (
+    DeviceWeightCache,
+    ManifestError,
+    SceneEntry,
+    SceneManifest,
+    ScenePreset,
+    SceneRegistry,
+    load_scene_params,
+    tree_nbytes,
+)
+from esac_tpu.utils.checkpoint import checkpoint_nbytes, save_checkpoint
+
+H = W = 16
+M = 2
+PRESET = ScenePreset(
+    height=H, width=W, num_experts=M,
+    stem_channels=(2, 2, 2), head_channels=2, head_depth=1,
+    gating_channels=(2,), compute_dtype="float32", gated=True,
+)
+CFG = RansacConfig(n_hyps=8, refine_iters=2, polish_iters=1,
+                   frame_buckets=(1, 4))
+POSE_KEYS = ("rvec", "tvec", "scores", "expert")
+
+
+def _write_scene(root: pathlib.Path, name: str, version: int, seed: int):
+    """A servable synthetic scene checkpoint pair (expert stack + gating)."""
+    expert = ExpertNet(
+        scene_center=(0.0, 0.0, 0.0), stem_channels=PRESET.stem_channels,
+        head_channels=PRESET.head_channels, head_depth=PRESET.head_depth,
+        compute_dtype=jnp.float32,
+    )
+    img = jnp.zeros((1, H, W, 3))
+    e_params = jax.vmap(lambda k: expert.init(k, img))(
+        jax.random.split(jax.random.key(seed), M)
+    )
+    centers = (np.asarray([[0.0, 0.0, 2.0]], np.float32)
+               + np.arange(M, dtype=np.float32)[:, None] * 0.1 + seed * 0.01)
+    d = root / f"{name}_v{version}"
+    save_checkpoint(d / "expert", e_params, {
+        "stem_channels": list(PRESET.stem_channels),
+        "head_channels": PRESET.head_channels,
+        "head_depth": PRESET.head_depth,
+        "scene_centers": centers.tolist(),
+        "f": 20.0, "c": [W / 2.0, H / 2.0],
+    })
+    gating = GatingNet(num_experts=M, channels=PRESET.gating_channels,
+                       compute_dtype=jnp.float32)
+    save_checkpoint(d / "gating", gating.init(jax.random.key(seed + 100), img),
+                    {"num_experts": M})
+    return SceneEntry(
+        scene_id=name, version=version,
+        expert_ckpt=str(d / "expert"), gating_ckpt=str(d / "gating"),
+        preset=PRESET, ransac=CFG,
+    )
+
+
+@pytest.fixture(scope="module")
+def scenes(tmp_path_factory):
+    """Three checkpoints: scene a v1+v2, scene b v1 (one shared preset)."""
+    root = tmp_path_factory.mktemp("registry_scenes")
+    return {
+        ("a", 1): _write_scene(root, "a", 1, seed=0),
+        ("a", 2): _write_scene(root, "a", 2, seed=5),
+        ("b", 1): _write_scene(root, "b", 1, seed=1),
+    }
+
+
+def _manifest(scenes, keys):
+    m = SceneManifest()
+    for k in keys:
+        m.add(scenes[k], activate=False)
+    return m
+
+
+def _frame(i):
+    img = jax.random.uniform(jax.random.fold_in(jax.random.key(42), i),
+                             (H, W, 3))
+    return {"key": jax.random.fold_in(jax.random.key(7), i),
+            "image": np.asarray(img)}
+
+
+def _bitwise_equal(a, b, keys=POSE_KEYS):
+    return all(np.array_equal(np.asarray(a[k]), np.asarray(b[k])) for k in keys)
+
+
+# ---------------- manifest: round-trip + rejection ----------------
+
+def test_manifest_round_trip(scenes):
+    m = _manifest(scenes, [("a", 1), ("a", 2), ("b", 1)])
+    m.promote("a", 2)
+    rt = SceneManifest.from_json(m.to_json())
+    assert rt.scene_ids() == ["a", "b"]
+    assert rt.versions("a") == [1, 2]
+    assert rt.resolve("a") == scenes[("a", 2)]
+    assert rt.resolve("b") == scenes[("b", 1)]
+    # previous pointer survives the round-trip: rollback still works
+    assert rt.rollback("a") == scenes[("a", 1)]
+    # file round-trip is the same path
+    rt.validate(check_paths=True)
+
+
+def test_manifest_save_load_atomic(scenes, tmp_path):
+    m = _manifest(scenes, [("a", 1)])
+    p = tmp_path / "manifest.json"
+    m.save(p)
+    assert SceneManifest.load(p).resolve("a") == scenes[("a", 1)]
+    assert not p.with_name(p.name + ".tmp").exists()
+
+
+def _valid_doc(scenes):
+    return _manifest(scenes, [("a", 1)]).to_dict()
+
+
+@pytest.mark.parametrize("mutate,err", [
+    (lambda d: d.update(format_version=99), "format_version"),
+    (lambda d: d.update(extra_field=1), "unknown field"),
+    (lambda d: d["scenes"]["a"].pop("versions"), "versions"),
+    (lambda d: d["scenes"]["a"].update(active=7), "active"),
+    (lambda d: d["scenes"]["a"].update(active="one"), "not an integer"),
+    (lambda d: d["scenes"]["a"].update(previous=7), "previous"),
+    (lambda d: d["scenes"]["a"].update(
+        versions=list(d["scenes"]["a"]["versions"])), "must be an object"),
+    (lambda d: d["scenes"]["a"]["versions"]["1"].update(surprise=1),
+     "unknown field"),
+    (lambda d: d["scenes"]["a"]["versions"]["1"].update(scene_id="zzz"),
+     "declares"),
+    (lambda d: d["scenes"]["a"]["versions"]["1"]["ransac"].update(n_hypz=4),
+     "ransac"),
+    (lambda d: d["scenes"]["a"]["versions"]["1"]["preset"].update(
+        compute_dtype="float8"), "compute_dtype"),
+    (lambda d: d["scenes"]["a"]["versions"]["1"]["preset"].update(height=17),
+     "stride"),
+    (lambda d: d["scenes"]["a"]["versions"]["1"].update(gating_ckpt=None),
+     "gated"),
+])
+def test_manifest_rejects_malformed(scenes, mutate, err):
+    doc = _valid_doc(scenes)
+    mutate(doc)
+    with pytest.raises(ManifestError, match=err):
+        SceneManifest.from_dict(json.loads(json.dumps(doc)))
+
+
+def test_manifest_rejects_non_json():
+    with pytest.raises(ManifestError, match="JSON"):
+        SceneManifest.from_json("{not json")
+
+
+def test_manifest_promote_rollback_pointers(scenes):
+    m = _manifest(scenes, [("a", 1), ("a", 2)])
+    assert m.resolve("a").version == 1  # first version auto-activates
+    m.promote("a", 2)
+    assert m.resolve("a").version == 2
+    m.rollback("a")
+    assert m.resolve("a").version == 1
+    m.rollback("a")  # rollback is a two-slot swap: undoes the rollback
+    assert m.resolve("a").version == 2
+    with pytest.raises(ManifestError, match="unregistered"):
+        m.promote("a", 3)
+    with pytest.raises(ManifestError, match="roll back"):
+        _manifest(scenes, [("b", 1)]).rollback("b")
+    with pytest.raises(ManifestError, match="duplicate"):
+        m.add(scenes[("a", 1)])
+    with pytest.raises(ManifestError, match="unknown scene"):
+        m.resolve("nope")
+
+
+# ---------------- device weight cache: LRU under a byte budget ----------
+
+@dataclasses.dataclass(frozen=True)
+class _FakeEntry:
+    scene_id: str
+    version: int = 1
+
+    @property
+    def key(self):
+        return (self.scene_id, self.version)
+
+
+def test_lru_eviction_order_under_byte_budget():
+    loads = []
+
+    def loader(entry):
+        loads.append(entry.key)
+        return {"w": np.zeros(256, np.float32)}  # 1024 B per scene
+
+    cache = DeviceWeightCache(loader, budget_bytes=2048)
+    a, b, c, d = (_FakeEntry(s) for s in "abcd")
+    cache.get(a); cache.get(b)
+    assert cache.keys() == [("a", 1), ("b", 1)] and not cache.evictions
+    cache.get(c)                      # over budget: a is LRU
+    assert list(cache.evictions) == [("a", 1)]
+    cache.get(b)                      # hit refreshes b ahead of c
+    cache.get(d)                      # now c is LRU
+    assert list(cache.evictions) == [("a", 1), ("c", 1)]
+    assert cache.keys() == [("b", 1), ("d", 1)]
+    assert cache.bytes_in_use == 2048
+    cache.get(a)                      # re-load after eviction = miss
+    assert loads == [("a", 1), ("b", 1), ("c", 1), ("d", 1), ("a", 1)]
+    assert cache.stats()["hits"] == 1 and cache.stats()["misses"] == 5
+    assert list(cache.evictions) == [("a", 1), ("c", 1), ("b", 1)]
+
+
+def test_cache_admits_oversized_entry_alone():
+    cache = DeviceWeightCache(
+        lambda e: {"w": np.zeros(1024, np.float32)}, budget_bytes=100
+    )
+    cache.get(_FakeEntry("big"))  # larger than the whole budget: admitted
+    assert cache.keys() == [("big", 1)]
+    cache.get(_FakeEntry("big2"))  # the previous one evicts, never the new
+    assert cache.keys() == [("big2", 1)]
+    assert list(cache.evictions) == [("big", 1)]
+
+
+def test_tree_nbytes_matches_checkpoint_nbytes(scenes):
+    e = scenes[("a", 1)]
+    host = load_scene_params(e)
+    # metadata-only sizing of the expert params equals the loaded reality
+    assert checkpoint_nbytes(e.expert_ckpt) == tree_nbytes(host["expert"])
+
+
+# ---------------- loader validation ----------------
+
+def test_load_scene_params_rejects_preset_mismatch(scenes):
+    e = scenes[("a", 1)]
+    bad = dataclasses.replace(
+        e, preset=dataclasses.replace(PRESET, stem_channels=(4, 4, 4))
+    )
+    with pytest.raises(ManifestError, match="stem_channels"):
+        load_scene_params(bad)
+
+
+def test_load_scene_params_rejects_unservable_checkpoint(scenes, tmp_path):
+    # a plain training checkpoint without scene metadata must be rejected
+    save_checkpoint(tmp_path / "ck", {"w": np.zeros(3, np.float32)},
+                    {"stem_channels": list(PRESET.stem_channels),
+                     "head_channels": PRESET.head_channels,
+                     "head_depth": PRESET.head_depth})
+    e = dataclasses.replace(scenes[("a", 1)], expert_ckpt=str(tmp_path / "ck"))
+    with pytest.raises(ManifestError, match="scene_centers"):
+        load_scene_params(e)
+
+
+# ---------------- serving: the ISSUE-4 acceptance properties ----------
+
+@pytest.fixture(scope="module")
+def registry(scenes):
+    m = _manifest(scenes, [("a", 1), ("a", 2), ("b", 1)])
+    return SceneRegistry(m)
+
+
+@pytest.fixture(scope="module")
+def dispatcher(registry):
+    return registry.dispatcher(CFG, start_worker=False)
+
+
+def test_hot_swap_compiles_once_and_matches_single_scene(
+        scenes, registry, dispatcher):
+    """THE acceptance test: arbitrary two-scene traffic through one
+    dispatcher compiles each (bucket-key, frame-bucket) program exactly
+    once, and every request's result is bit-identical to a fresh
+    single-scene server for its scene."""
+    frames = [_frame(i) for i in range(3)]
+    # interleaved single requests + a bulk dispatch per scene: traffic
+    # covers both frame buckets for both scenes
+    ra = [dispatcher.infer_one(f, scene="a") for f in frames]
+    rb = [dispatcher.infer_one(f, scene="b") for f in frames]
+    ra_bulk = dispatcher.infer_many(frames, scene="a")
+    rb_bulk = dispatcher.infer_many(frames, scene="b")
+    # 2 frame buckets x 1 shared bucket key, however many scenes swapped:
+    assert dispatcher.cache_size() == len(set(CFG.frame_buckets))
+    # the scenes genuinely serve different weights
+    assert not np.array_equal(ra[0]["rvec"], rb[0]["rvec"])
+    # bulk (4-bucket) vs single (1-bucket) dispatches agree bitwise (the
+    # serve-path bucket-invariance, now per scene)
+    for got, want in zip(ra_bulk, ra):
+        assert _bitwise_equal(got, want)
+    # fresh single-scene servers reproduce every result bit-for-bit
+    for sid, results in (("a", ra), ("b", rb)):
+        solo = SceneRegistry(_manifest(scenes, [(sid, 1)]))
+        disp = solo.dispatcher(CFG, start_worker=False)
+        for f, want in zip(frames, results):
+            assert _bitwise_equal(disp.infer_one(f, scene=sid), want)
+
+
+def test_cold_warm_postswap_bit_identical_under_eviction(scenes):
+    """The same request answers bit-identically whether its scene's weights
+    were just cold-loaded, warm in cache, or re-staged after an eviction
+    forced by swapping to another scene (budget fits ONE scene)."""
+    one_scene = tree_nbytes(load_scene_params(scenes[("a", 1)]))
+    reg = SceneRegistry(_manifest(scenes, [("a", 1), ("b", 1)]),
+                        budget_bytes=one_scene + 1)
+    disp = reg.dispatcher(CFG, start_worker=False)
+    f = _frame(0)
+    cold = disp.infer_one(f, scene="a")          # miss: cold load
+    warm = disp.infer_one(f, scene="a")          # hit
+    disp.infer_one(f, scene="b")                 # evicts a
+    assert list(reg.cache.evictions) == [("a", 1)]
+    post_swap = disp.infer_one(f, scene="a")     # miss again: re-staged
+    assert list(reg.cache.evictions) == [("a", 1), ("b", 1)]
+    assert _bitwise_equal(cold, warm) and _bitwise_equal(cold, post_swap)
+    assert reg.cache.stats()["misses"] == 3 and reg.cache.stats()["hits"] == 1
+
+
+def test_two_scene_concurrent_dispatch_fairness(registry):
+    """Requests for two scenes queued before the worker starts coalesce
+    per scene (a dispatch never mixes scenes) and are served round-robin;
+    results match the synchronous path bitwise."""
+    frames = [_frame(10 + i) for i in range(2)]
+    sync = registry.dispatcher(CFG, start_worker=False)
+    want = {s: [sync.infer_one(f, scene=s) for f in frames]
+            for s in ("a", "b")}
+    disp = registry.dispatcher(CFG, start_worker=False)
+    reqs = [(s, disp.submit(f, scene=s))
+            for f in frames for s in ("a", "b")]  # interleaved a,b,a,b
+    disp.start()
+    for _, r in reqs:
+        assert r.event.wait(120.0)
+    disp.close()
+    # one dispatch per scene (both requests of a scene coalesced), scene
+    # order = round-robin from the queue order
+    assert list(disp.scene_log) == ["a", "b"]
+    assert list(disp.dispatch_log) == [(4, 2), (4, 2)]
+    for i, (s, r) in enumerate(reqs):
+        assert r.error is None
+        assert _bitwise_equal(r.result, want[s][i // 2])
+
+
+def test_promote_rollback_switch_served_weights(scenes, registry, dispatcher):
+    """A promote atomically changes which weights serve a scene for every
+    LATER dispatch; rollback restores the old results bit-for-bit."""
+    f = _frame(20)
+    v1 = dispatcher.infer_one(f, scene="a")
+    registry.manifest.promote("a", 2)
+    try:
+        v2 = dispatcher.infer_one(f, scene="a")
+        assert not np.array_equal(v1["rvec"], v2["rvec"])
+        solo = SceneRegistry(_manifest(scenes, [("a", 2)]))
+        got = solo.dispatcher(CFG, start_worker=False).infer_one(f, scene="a")
+        assert _bitwise_equal(got, v2)
+    finally:
+        registry.manifest.rollback("a")
+    assert _bitwise_equal(dispatcher.infer_one(f, scene="a"), v1)
+    # version swapping reused the same compiled programs
+    assert dispatcher.cache_size() == len(set(CFG.frame_buckets))
+
+
+def test_scene_and_legacy_traffic_share_a_dispatcher(registry):
+    """scene=None requests keep the one-argument infer_fn contract even on
+    a dispatcher whose other traffic is scene-keyed."""
+    calls = []
+
+    def fake_infer(tree, scene=None):
+        calls.append(scene)
+        return {"echo": tree["x"]}
+
+    from esac_tpu.serve import MicroBatchDispatcher
+
+    disp = MicroBatchDispatcher(fake_infer, CFG, start_worker=False)
+    disp.infer_one({"x": np.zeros(3, np.float32)}, scene="a")
+    disp.infer_one({"x": np.zeros(3, np.float32)})
+    assert calls == ["a", None]
+    assert list(disp.scene_log) == ["a", None]
+
+
+# ---------------- heavy leg: registry-backed sharded serving ----------
+
+@pytest.mark.slow
+def test_heavy_registry_sharded_serve_hot_swaps_intrinsics(scenes):
+    """make_registry_sharded_serve_fn: one compiled sharded program serves
+    scenes with different principal points (c is a traced argument), and
+    each scene's poses match the closure-built sharded path."""
+    from esac_tpu.data import make_correspondence_frame
+    from esac_tpu.parallel import make_mesh
+    from esac_tpu.registry import make_registry_sharded_serve_fn
+    from esac_tpu.serve import MicroBatchDispatcher, make_sharded_serve_fn
+
+    M_sh, B = 4, 2
+    mesh = make_mesh(n_data=2, n_expert=4)
+    cfg = dataclasses.replace(CFG, frame_buckets=(4,))
+    cs = {"a": np.asarray([80.0, 60.0], np.float32),
+          "b": np.asarray([82.0, 58.0], np.float32)}
+    man = _manifest(scenes, [("a", 1), ("b", 1)])
+    reg = SceneRegistry(man, loader=lambda e: {"c": cs[e.scene_id]})
+    fn = make_registry_sharded_serve_fn(mesh, reg, cfg)
+    disp = MicroBatchDispatcher(fn, cfg, start_worker=False)
+
+    frames = []
+    for i in range(B):
+        fr = make_correspondence_frame(
+            jax.random.key(60 + i), noise=0.01, outlier_frac=0.3,
+            height=120, width=160, f=131.25, c=(80.0, 60.0),
+        )
+        coords = np.asarray(fr["coords"])
+        maps = [coords if m == i % M_sh else coords + 2.0 + m
+                for m in range(M_sh)]
+        frames.append({
+            "key": jax.random.fold_in(jax.random.key(8), i),
+            "coords_all": np.stack(maps),
+            "pixels": np.asarray(fr["pixels"]),
+            "f": np.float32(131.25),
+        })
+    outs = {s: disp.infer_many(frames, scene=s) for s in ("a", "b")}
+    assert disp.cache_size() == 1  # both scenes, one compiled program
+    for s in ("a", "b"):
+        base = MicroBatchDispatcher(
+            make_sharded_serve_fn(mesh, cs[s], cfg), cfg, start_worker=False
+        )
+        want = base.infer_many(frames)
+        for got, w in zip(outs[s], want):
+            assert int(got["expert"]) == int(w["expert"])
+            np.testing.assert_allclose(got["rvec"], w["rvec"], atol=1e-4)
+            np.testing.assert_allclose(got["tvec"], w["tvec"], atol=1e-4)
