@@ -205,6 +205,82 @@ def _build_esac_infer_frames():
     )(keys, coords_B)
 
 
+def _build_esac_infer_routed_frames():
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.ransac.config import RansacConfig
+    from esac_tpu.ransac.esac import esac_infer_routed_frames
+
+    coords, pixels, f, c = _geom_inputs()
+    B, M, K = 2, 4, 2
+    cfg = RansacConfig(n_hyps=8, refine_iters=2, polish_iters=1)
+    keys = jax.random.split(jax.random.key(8), B)
+    coords_sel = jnp.stack([
+        jnp.stack([coords, coords + 0.1]),
+        jnp.stack([coords + 0.05, coords + 0.2]),
+    ])  # (B, K, N, 3)
+    logits_B = jnp.zeros((B, M))
+    selected = jnp.tile(jnp.asarray([1, 3], jnp.int32)[None], (B, 1))
+    kept = jnp.asarray([[True, True], [True, False]])
+    pixels_B = jnp.stack([pixels, pixels])
+    f_B = jnp.stack([f, f])
+    return jax.make_jaxpr(
+        lambda k, co: esac_infer_routed_frames(
+            k, logits_B, co, selected, kept, pixels_B, f_B, c, cfg
+        )
+    )(keys, coords_sel)
+
+
+def _build_routed_scene_serve():
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.ransac.config import RansacConfig
+    from esac_tpu.registry.manifest import ScenePreset
+    from esac_tpu.registry.serving import make_routed_scene_bucket_fn
+
+    H = W = 16
+    M, B = 4, 2
+    preset = ScenePreset(
+        height=H, width=W, num_experts=M,
+        stem_channels=(2, 2, 2), head_channels=2, head_depth=1,
+        gating_channels=(2,), compute_dtype="float32", gated=True,
+    )
+    cfg = RansacConfig(n_hyps=4, refine_iters=1, polish_iters=1,
+                       frame_buckets=(1, 4))
+    # k < M so the traced program is the REAL two-phase routed pipeline
+    # (gating -> top-k -> capacity blocks -> scatter -> routed esac), not
+    # the K=M dense specialization.
+    fn = make_routed_scene_bucket_fn(preset, cfg, 2)
+
+    from esac_tpu.models.expert import ExpertNet
+    from esac_tpu.models.gating import GatingNet
+
+    expert = ExpertNet(scene_center=(0.0, 0.0, 0.0),
+                       stem_channels=preset.stem_channels,
+                       head_channels=preset.head_channels,
+                       head_depth=preset.head_depth,
+                       compute_dtype=jnp.float32)
+    gating = GatingNet(num_experts=M, channels=preset.gating_channels,
+                       compute_dtype=jnp.float32)
+    img = jnp.zeros((1, H, W, 3))
+    params = {
+        "expert": jax.vmap(lambda k: expert.init(k, img))(
+            jax.random.split(jax.random.key(0), M)
+        ),
+        "gating": gating.init(jax.random.key(1), img),
+        "centers": jnp.zeros((M, 3)),
+        "c": jnp.asarray([W / 2.0, H / 2.0]),
+        "f": jnp.float32(20.0),
+    }
+    batch = {
+        "key": jax.random.split(jax.random.key(2), B),
+        "image": jnp.zeros((B, H, W, 3)),
+    }
+    return jax.make_jaxpr(fn)(params, batch)
+
+
 def _build_registry_scene_serve():
     import jax
     import jax.numpy as jnp
@@ -314,6 +390,22 @@ ENTRIES: tuple[Entry, ...] = (
                "per dispatch, the DESIGN.md §9 amortization path"),
     Entry("esac_infer_frames", pinned=True, build=_build_esac_infer_frames,
           note="frames-major multi-expert serving dispatch"),
+    Entry("esac_infer_routed_frames", pinned=True,
+          build=_build_esac_infer_routed_frames,
+          note="capacity-routed frames-major hypothesis loop (DESIGN.md "
+               "§11): gathered expert subsets, drop masking, reallocated "
+               "budget — the RANSAC stage of the routed serve programs; "
+               "pure geometry, so dot precision IS audited"),
+    Entry("routed_scene_serve", pinned=False,
+          build=_build_routed_scene_serve,
+          note="gating-first routed bucket program (esac_tpu.registry, "
+               "k < M so the capacity dispatch itself is traced): gating "
+               "CNN -> top-k -> per-expert frame blocks -> scatter -> "
+               "routed esac, weights as traced jit arguments; CNN compute "
+               "is legitimately bf16 in production presets so dot "
+               "precision is not audited, but primitives/static-shapes "
+               "are — the sparse hot path must stay scan/while-free and "
+               "fixed-shape"),
     Entry("registry_scene_serve", pinned=False,
           build=_build_registry_scene_serve,
           note="multi-scene registry bucket program (esac_tpu.registry): "

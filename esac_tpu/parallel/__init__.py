@@ -23,8 +23,9 @@ see PARALLELISM.md at the repo root for the explicit mapping.
 from esac_tpu.parallel.mesh import make_mesh, expert_sharding, batch_sharding
 from esac_tpu.parallel.esac_sharded import (
     esac_infer_routed, esac_infer_sharded, esac_infer_sharded_frames,
-    make_esac_infer_sharded_frames, make_esac_infer_sharded_frames_dynamic,
-    pad_experts_for_mesh, pad_gating_logits,
+    make_esac_infer_routed_frames_sharded, make_esac_infer_sharded_frames,
+    make_esac_infer_sharded_frames_dynamic, pad_experts_for_mesh,
+    pad_gating_logits, route_frames_to_experts,
 )
 from esac_tpu.parallel.multihost import initialize_multihost
 from esac_tpu.parallel.train_sharded import make_sharded_esac_loss, shard_esac_params
@@ -37,10 +38,12 @@ __all__ = [
     "esac_infer_sharded",
     "esac_infer_sharded_frames",
     "initialize_multihost",
+    "make_esac_infer_routed_frames_sharded",
     "make_esac_infer_sharded_frames",
     "make_esac_infer_sharded_frames_dynamic",
     "make_sharded_esac_loss",
     "pad_experts_for_mesh",
     "pad_gating_logits",
+    "route_frames_to_experts",
     "shard_esac_params",
 ]

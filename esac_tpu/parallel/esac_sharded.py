@@ -39,7 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from esac_tpu.parallel.mesh import shard_map
 from esac_tpu.ransac.config import RansacConfig
-from esac_tpu.ransac.esac import _per_expert_hypotheses
+from esac_tpu.ransac.esac import _per_expert_hypotheses, _routed_frame_winner
 from esac_tpu.ransac.kernel import _split_score_key
 from esac_tpu.ransac.refine import refine_soft_inliers
 
@@ -282,6 +282,191 @@ def pad_gating_logits(logits: jnp.ndarray, M_pad: int) -> jnp.ndarray:
         return logits
     pad = jnp.full(logits.shape[:-1] + (extra,), -jnp.inf, logits.dtype)
     return jnp.concatenate([logits, pad], axis=-1)
+
+
+def route_frames_to_experts(selected: jnp.ndarray, num_experts: int,
+                            capacity: int):
+    """The MoE capacity dispatch shared by the routed SERVE paths: assign
+    each (frame, selected-expert) pair a slot in that expert's fixed-size
+    frame block, dropping overflow deterministically.
+
+    ``selected``: (B, K) int32 global expert ids per frame (distinct within
+    a frame — ``ransac.esac.select_topk_experts`` output); ``capacity`` is
+    the static per-expert block width C.  Drop priority is FRAME INDEX:
+    frame b's slot in expert m's block is the count of earlier frames that
+    also selected m, and slots >= C drop.  That rule is what makes the
+    serve-path bucket-invariance contract hold: tail padding appends pad
+    frames AFTER every real frame, so a pad lane can occupy capacity only
+    behind all real claimants and can never displace a real (frame, expert)
+    pair (pinned in tests/test_serve_routed.py).
+
+    Returns ``(kept, pos, slot_frame, slot_valid)``:
+
+    - ``kept``       (B, K) bool — pair survived capacity;
+    - ``pos``        (B, K) int32 — slot index in the expert's block
+      (meaningful where ``kept``; clip before gathering);
+    - ``slot_frame`` (M, C) int32 — frame index riding each block slot
+      (0-filled where invalid: finite-garbage compute, masked downstream);
+    - ``slot_valid`` (M, C) bool.
+
+    Everything is static-shaped (one_hot + cumsum + comparisons); both the
+    single-chip routed bucket programs (registry/serving.py) and the
+    expert-sharded routed serve path below dispatch through this function,
+    so their drop semantics cannot diverge.
+    """
+    B, K = selected.shape
+    onehot = jax.nn.one_hot(selected, num_experts, dtype=jnp.int32)  # (B,K,M)
+    mask = onehot.sum(axis=1)  # (B, M) in {0, 1}: frame b selected expert m
+    # Earlier-frames-first positions: frame b's slot in m's block is the
+    # number of frames < b that selected m.
+    order = jnp.cumsum(mask, axis=0) - mask  # (B, M)
+    kept_bm = (mask == 1) & (order < capacity)
+    pos = jnp.take_along_axis(order, selected, axis=1).astype(jnp.int32)
+    kept = jnp.take_along_axis(kept_bm, selected, axis=1)
+    slot_hit = (
+        kept_bm.T[:, None, :]
+        & (order.T[:, None, :] == jnp.arange(capacity)[None, :, None])
+    )  # (M, C, B)
+    slot_valid = slot_hit.any(axis=-1)
+    slot_frame = jnp.argmax(slot_hit, axis=-1).astype(jnp.int32)
+    return kept, pos, slot_frame, slot_valid
+
+
+def make_esac_infer_routed_frames_sharded(
+    mesh: Mesh,
+    expert_apply,
+    e_stack,
+    centers: jnp.ndarray,
+    cfg: RansacConfig = RansacConfig(),
+    k: int = 4,
+    capacity: int | None = None,
+):
+    """Expert-sharded, frames-major, gating-first routed SERVE path.
+
+    The sharded sibling of ``registry.make_routed_scene_bucket_fn``: per
+    frame the global top-``k`` experts by gating are selected, each shard
+    runs CNN forwards only for its LOCAL selected experts — routed through
+    :func:`route_frames_to_experts` into fixed ``capacity``-frame blocks
+    (one batched forward per local expert instead of per-(frame, expert)
+    param gathers) — and the winner rides the shared
+    :func:`_winner_allreduce`.  ``capacity`` defaults to
+    ``ransac.esac.routed_serve_capacity(cfg, k, M)``.
+
+    Returned callable: ``infer(keys, gating_logits, images, focals,
+    pixels, c) -> dict`` with keys (B,) typed PRNG keys, gating_logits
+    (B, M) and images (B, H, W, 3) replicated, focals (B,), pixels (N, 2),
+    c (2,); outputs are (B,)-leading and replicated, with
+    ``experts_evaluated`` (B, k) global ids (sentinel M = dropped) exactly
+    matching the single-chip routed program's accounting.  Per-frame
+    hypothesis work (``k`` slots x the reallocated budget) is replicated
+    across shards — the CNN forwards are what this path shards; right when
+    the expert networks dominate, which is the routed regime's premise.
+    RNG: per-expert hypothesis streams are keyed by GLOBAL expert index
+    (no per-shard fold), so evaluated pairs score bit-identically to the
+    single-chip routed program.
+    """
+    import dataclasses
+
+    from esac_tpu.ransac.esac import (
+        routed_serve_capacity,
+        select_topk_experts,
+    )
+
+    n_shards = mesh.shape["expert"]
+    M = centers.shape[0]
+    if M % n_shards != 0:
+        raise ValueError(
+            f"M={M} not divisible by expert shards {n_shards}; "
+            "pad with pad_experts_for_mesh"
+        )
+    m_local = M // n_shards
+    k = min(k, M)
+    cap = (capacity if capacity is not None
+           else routed_serve_capacity(cfg, k, M))
+    nh = max(1, (cfg.n_hyps * M) // k)
+    cfg_k = dataclasses.replace(cfg, n_hyps=nh)
+
+    e_specs = jax.tree.map(lambda _: P("expert"), e_stack)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), e_specs, P("expert"), P(), P()),
+        out_specs=(P(), P(), P(), P(), P()),
+    )
+    def body(keys_B, logits_B, images_B, focals_B, e_local, centers_local,
+             px, c_pt):
+        shard_id = jax.lax.axis_index("expert")
+        lo = shard_id * m_local
+        selected = select_topk_experts(logits_B, k)  # (B, k), replicated calc
+        kept, pos, slot_frame, slot_valid = route_frames_to_experts(
+            selected, M, cap
+        )
+        # Only this shard's expert rows of the global block table.
+        slot_frame_l = jax.lax.dynamic_slice(
+            slot_frame, (lo, 0), (m_local, cap)
+        )
+        blocks = images_B[slot_frame_l]  # (m_local, C, H, W, 3)
+        coords_b = jax.vmap(expert_apply)(e_local, blocks)
+        coords_b = coords_b.reshape(m_local, cap, -1, 3) \
+            + centers_local[:, None, None, :]
+        is_local = (selected >= lo) & (selected < lo + m_local)  # (B, k)
+        live = kept & is_local
+        sel_l = jnp.clip(selected - lo, 0, m_local - 1)
+        coords_sel = coords_b[sel_l, jnp.minimum(pos, cap - 1)]  # (B,k,N,3)
+
+        def one_frame(key, logits, co_sel, sel, lv, fi):
+            rvec, tvec, scores, mi, best = _routed_frame_winner(
+                key, co_sel, sel, lv, px, fi, c_pt, cfg_k, M
+            )
+            # A shard with no live slot for this frame must lose the
+            # all-reduce and never collide in the tie-break — EXCEPT when
+            # the whole frame dropped on every shard: then the shard
+            # owning sel[0] claims it (all scores are -inf, so the
+            # tie-break elects that unique claimant), matching the
+            # single-chip entry's failed-frame output `sel[argmax(-inf)]
+            # == sel[0]` — 'expert' stays a real 0..M-1 id and exactly
+            # one shard's (finite-garbage) pose survives the psum.
+            owner0 = (sel[0] >= lo) & (sel[0] < lo + m_local)
+            g_expert = jnp.where(
+                lv.any(), sel[mi], jnp.where(owner0, sel[0], M)
+            )
+            return rvec, tvec, best, g_expert
+
+        rvec, tvec, local_score, g_expert = jax.vmap(one_frame)(
+            keys_B, logits_B, coords_sel, selected, live, focals_B
+        )
+        rvec_g, tvec_g, win, best = _winner_allreduce(
+            local_score, g_expert, rvec, tvec, M + 1
+        )
+        # Each (frame, slot) pair is owned by exactly one shard; pmin over
+        # the expert axis recovers the owner's verdict (M = dropped).
+        evaluated_local = jnp.where(live, selected, M)
+        evaluated = jax.lax.pmin(evaluated_local, "expert")
+        return rvec_g, tvec_g, win, best, evaluated
+
+    jit_body = jax.jit(body)
+
+    def infer(keys, gating_logits, images, focals, pixels, c):
+        if gating_logits.shape[-1] != M:
+            raise ValueError(
+                f"gating_logits last dim {gating_logits.shape[-1]} != "
+                f"expert count {M}"
+            )
+        rvec, tvec, expert, score, evaluated = jit_body(
+            keys, gating_logits, images, focals, e_stack, centers,
+            pixels, jnp.asarray(c),
+        )
+        return {
+            "rvec": rvec,
+            "tvec": tvec,
+            "expert": expert,
+            "score": score,
+            "experts_evaluated": evaluated,
+        }
+
+    infer._cache_size = jit_body._cache_size
+    return infer
 
 
 def esac_infer_routed(

@@ -21,9 +21,12 @@ from esac_tpu.ransac.kernel import (
 from esac_tpu.ransac.esac import (
     esac_infer,
     esac_infer_frames,
+    esac_infer_routed_frames,
     esac_infer_topk,
     esac_infer_topk_frames,
     esac_train_loss,
+    routed_serve_capacity,
+    select_topk_experts,
 )
 
 __all__ = [
@@ -38,8 +41,11 @@ __all__ = [
     "dsac_train_loss",
     "esac_infer",
     "esac_infer_frames",
+    "esac_infer_routed_frames",
     "esac_infer_topk",
     "esac_infer_topk_frames",
     "esac_train_loss",
     "pose_loss",
+    "routed_serve_capacity",
+    "select_topk_experts",
 ]

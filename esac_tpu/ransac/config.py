@@ -100,3 +100,20 @@ class RansacConfig:
     # Backpressure bound on queued-but-undispatched requests; submitters
     # block (never drop) once the queue is full.
     serve_queue_depth: int = 256
+    # ---- Gating-first routed serving knobs (DESIGN.md §11) ----
+    # Default top-K experts evaluated per frame by the routed serve programs
+    # (registry.make_routed_scene_bucket_fn).  0 = dense serving (all M
+    # experts); K = M routes identically to dense (pinned bit-identical).
+    # The hypothesis budget is reallocated so total hypotheses per frame
+    # stay fixed: each evaluated expert runs n_hyps * M // K hypotheses.
+    serve_topk: int = 0
+    # Frame capacity of each expert's CNN block in the routed serve
+    # programs: at most this many frames run through one expert per
+    # dispatch; overflow (frame-index priority, latest frames drop first)
+    # is recorded in `experts_evaluated`.  0 = auto:
+    # ceil(2 * K * max_bucket / M), i.e. 2x the balanced per-expert load
+    # at the LARGEST frame bucket — deliberately bucket-independent, since
+    # a capacity that varied with the frame bucket would let padding
+    # change which (frame, expert) pairs survive and break the
+    # bucket-invariance contract (see ransac.esac.routed_serve_capacity).
+    serve_capacity: int = 0

@@ -228,6 +228,147 @@ def esac_infer_topk_frames(
     )(keys, gating_logits, coords_all, pixels, f)
 
 
+def select_topk_experts(gating_logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Per-frame top-``k`` expert ids by gating logit, sorted ASCENDING by
+    global expert index.  gating_logits (..., M) -> (..., k) int32.
+
+    The ascending sort is load-bearing for the routed serve path's K=M
+    bit-parity pin: with every expert selected, the slot layout becomes
+    exactly 0..M-1, so the routed hypothesis loop evaluates the same
+    (expert, key) pairs in the same order as the dense path and reduces to
+    it bit-for-bit (tests/test_serve_routed.py).
+    """
+    _, top = jax.lax.top_k(gating_logits, k)
+    return jnp.sort(top, axis=-1).astype(jnp.int32)
+
+
+def routed_serve_capacity(cfg: RansacConfig, k: int, num_experts: int) -> int:
+    """Static per-expert frame capacity of the routed serve programs.
+
+    ``cfg.serve_capacity > 0`` wins; otherwise auto-size to 2x the
+    balanced per-expert load at the LARGEST frame bucket,
+    ``ceil(2 * k * max_bucket / M)``.  Two invariants, both required by
+    the serve-path bit-parity contract:
+
+    - **Bucket-independent.**  The capacity must be one constant per
+      (cfg, K) — never a function of the dispatch's frame bucket — or a
+      request's surviving (frame, expert) pairs would depend on which
+      bucket it rode (a larger bucket's laxer capacity would keep pairs a
+      smaller bucket drops).
+    - **>= 2 block lanes.**  Expert blocks run CNN forwards at batch width
+      ``capacity``; a collapsed width-1 batch specializes differently
+      under XLA (the serve.batching.MIN_LANES measurement), so the floor
+      keeps block results per-lane stable across capacities.
+    """
+    big = max(2, max(cfg.frame_buckets))
+    cap = cfg.serve_capacity if cfg.serve_capacity > 0 \
+        else -(-2 * k * big // num_experts)
+    return max(2, min(cap, big))
+
+
+def _routed_frame_winner(key, co_sel, sel, live, px, fi, c, cfg_k, M):
+    """One frame of the capacity-routed hypothesis loop: global-index RNG
+    streams, generate + score over the K gathered expert maps, ``-inf``
+    masking of non-live slots, flat argmax, winner-only refine.
+
+    Shared VERBATIM by :func:`esac_infer_routed_frames` and
+    ``parallel.make_esac_infer_routed_frames_sharded`` so their bit-level
+    agreement on evaluated pairs is structural, not merely pinned by the
+    (slow) cross-path test.  ``cfg_k`` is the budget-reallocated config;
+    returns ``(rvec, tvec, scores, mi, best)`` — refined winner pose,
+    masked (K, nh) scores, winning slot index, winning score.
+    """
+    k_hyp, k_sub = _split_score_key(key, cfg_k)
+    keys_sel = jax.random.split(k_hyp, M)[sel]  # global-index streams
+    rvecs, tvecs = jax.vmap(
+        lambda kk, co: generate_hypotheses(kk, co, px, fi, c, cfg_k)
+    )(keys_sel, co_sel)
+    scores = jax.vmap(
+        lambda rv, tv, co: _score_hypotheses(
+            k_sub, rv, tv, co, px, fi, c, cfg_k
+        )
+    )(rvecs, tvecs, co_sel)
+    scores = jnp.where(live[:, None], scores, -jnp.inf)
+    nh = scores.shape[1]
+    flat = jnp.argmax(scores.reshape(-1))
+    mi, j = flat // nh, flat % nh
+    rvec, tvec = refine_soft_inliers(
+        rvecs[mi, j], tvecs[mi, j], co_sel[mi], px, fi, c,
+        cfg_k.tau, cfg_k.beta, iters=cfg_k.refine_iters,
+    )
+    return rvec, tvec, scores, mi, scores[mi, j]
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def esac_infer_routed_frames(
+    keys: jax.Array,
+    gating_logits: jnp.ndarray,
+    coords_sel: jnp.ndarray,
+    selected: jnp.ndarray,
+    kept: jnp.ndarray,
+    pixels: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    cfg: RansacConfig = RansacConfig(),
+) -> dict:
+    """Frames-major hypothesis loop over capacity-routed expert subsets —
+    the RANSAC stage of the gating-first routed serve programs
+    (DESIGN.md §11; registry.make_routed_scene_bucket_fn runs the CNNs and
+    the capacity dispatch upstream via
+    ``parallel.route_frames_to_experts``).
+
+    keys (B,) typed PRNG keys; gating_logits (B, M); coords_sel
+    (B, K, N, 3) the selected experts' coordinate maps, gathered back from
+    the per-expert capacity blocks; selected (B, K) int32 global expert
+    ids, sorted ascending (``select_topk_experts``); kept (B, K) bool —
+    False where the capacity dispatch dropped the pair; pixels (B, N, 2);
+    f (B,); c (2,) shared.
+
+    Semantics are ``esac_infer_topk_frames``'s with two extensions:
+
+    - **Budget reallocation**: each evaluated expert runs
+      ``cfg.n_hyps * M // K`` hypotheses, so the TOTAL per-frame budget is
+      fixed at ``M * cfg.n_hyps`` independent of K — routing buys CNN
+      sparsity, not a smaller search.
+    - **Drop masking**: dropped slots score ``-inf`` (they can never win;
+      their gathered coords are finite garbage by construction) and
+      surface in ``experts_evaluated`` as the sentinel ``M`` — the same
+      accounting contract as ``parallel.esac_infer_routed``.  A frame
+      whose every slot dropped fails with finite garbage, like a gating
+      miss.
+
+    At K == M (with nothing dropped) ``selected`` is 0..M-1, the budget
+    factor is 1, and every per-expert RNG stream — keyed by GLOBAL expert
+    index via ``jax.random.split(key, M)[selected]`` — coincides with the
+    dense path's, so the result is bit-identical to
+    :func:`esac_infer_frames` (pinned in tests/test_serve_routed.py).
+    """
+    import dataclasses
+
+    M = gating_logits.shape[-1]
+    K = selected.shape[-1]
+    nh = max(1, (cfg.n_hyps * M) // K)
+    cfg_k = dataclasses.replace(cfg, n_hyps=nh)
+
+    def one_frame(key, logits, co_sel, sel, kp, px, fi):
+        rvec, tvec, scores, mi, best = _routed_frame_winner(
+            key, co_sel, sel, kp, px, fi, c, cfg_k, M
+        )
+        return {
+            "rvec": rvec,
+            "tvec": tvec,
+            "expert": sel[mi],
+            "scores": scores,
+            "experts_evaluated": jnp.where(kp, sel, M).astype(jnp.int32),
+            "gating_probs": jax.nn.softmax(logits),
+            "inlier_frac": best / px.shape[0],
+        }
+
+    return jax.vmap(one_frame)(
+        keys, gating_logits, coords_sel, selected, kept, pixels, f
+    )
+
+
 @partial(jax.jit, static_argnames=("cfg", "mode"))
 def esac_train_loss(
     key: jax.Array,

@@ -24,6 +24,7 @@ from esac_tpu.registry.serving import (
     SceneRegistry,
     load_scene_params,
     make_registry_sharded_serve_fn,
+    make_routed_scene_bucket_fn,
     make_scene_bucket_fn,
 )
 
@@ -38,6 +39,7 @@ __all__ = [
     "entry_to_dict",
     "load_scene_params",
     "make_registry_sharded_serve_fn",
+    "make_routed_scene_bucket_fn",
     "make_scene_bucket_fn",
     "tree_nbytes",
 ]

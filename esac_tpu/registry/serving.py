@@ -166,6 +166,121 @@ def make_scene_bucket_fn(preset: ScenePreset, cfg: RansacConfig):
     return jax.jit(run, donate_argnums=donate)
 
 
+def make_routed_scene_bucket_fn(preset: ScenePreset, cfg: RansacConfig,
+                                k: int):
+    """Gating-FIRST routed bucket program: one jitted two-phase pipeline
+    per (preset, cfg, K) — the sparse-serve counterpart of
+    :func:`make_scene_bucket_fn` (DESIGN.md §11).
+
+    Phase 1 runs only the gating CNN and selects each frame's top-``k``
+    experts; phase 2 executes ONLY the selected expert CNNs via the
+    static-shaped MoE capacity dispatch
+    (``parallel.route_frames_to_experts``): each expert gathers up to
+    ``routed_serve_capacity(cfg, k, M)`` frames that selected it into one
+    fixed block, runs ONE batched forward over the block (weights read
+    once per dispatch — gather-frames-per-expert, not
+    gather-params-per-frame), and the coordinates scatter back to the
+    per-frame (B, K, N, 3) layout that ``ransac.esac_infer_routed_frames``
+    consumes with the full hypothesis budget reallocated over the K
+    evaluated experts.  Capacity overflow drops (frame-index priority) are
+    finite-garbage-masked and accounted in ``experts_evaluated``
+    (sentinel M).
+
+    ``k == preset.num_experts`` routing is the identity, so the program
+    statically specializes to the dense CNN schedule and the whole
+    pipeline is bit-identical to :func:`make_scene_bucket_fn` (pinned in
+    tests/test_serve_routed.py) — K=M is the zero-risk fallback, not a
+    separate code path to trust.
+
+    Weights stay traced jit ARGUMENTS exactly as in the dense bucket fn:
+    hot-swapping scenes through a routed program never recompiles, and one
+    program compiles per (bucket key, K, frame bucket).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.data.synthetic import output_pixel_grid
+    from esac_tpu.models.expert import ExpertNet
+    from esac_tpu.models.gating import GatingNet
+    from esac_tpu.parallel.esac_sharded import route_frames_to_experts
+    from esac_tpu.ransac.esac import (
+        esac_infer_routed_frames,
+        routed_serve_capacity,
+        select_topk_experts,
+    )
+
+    M = preset.num_experts
+    if not 1 <= k <= M:
+        raise ValueError(f"routed top-k {k} outside 1..{M}")
+    if k < M and not preset.gated:
+        raise ValueError(
+            "routed serving with k < num_experts needs a gated preset: "
+            "without a gating net every frame would ride the same "
+            "arbitrary expert subset"
+        )
+    cap = routed_serve_capacity(cfg, k, M)
+
+    dtype = jnp.bfloat16 if preset.compute_dtype == "bfloat16" else jnp.float32
+    expert = ExpertNet(
+        scene_center=(0.0, 0.0, 0.0),  # real centers ride params["centers"]
+        stem_channels=preset.stem_channels,
+        head_channels=preset.head_channels,
+        head_depth=preset.head_depth,
+        compute_dtype=dtype,
+    )
+    gating = GatingNet(
+        num_experts=M,
+        channels=preset.gating_channels,
+        compute_dtype=dtype,
+    ) if preset.gated else None
+    pixels = output_pixel_grid(preset.height, preset.width, preset.stride)
+
+    def run(params, batch):
+        imgs = batch["image"]
+        B = imgs.shape[0]
+        if gating is not None:
+            logits = gating.apply(params["gating"], imgs)  # (B, M)
+        else:
+            logits = jnp.zeros((B, M), jnp.float32)
+        if k == M:
+            # Identity routing: the dense CNN schedule (bit-parity with
+            # make_scene_bucket_fn by construction; see docstring).
+            coords = jax.vmap(lambda pe: expert.apply(pe, imgs))(
+                params["expert"]
+            )
+            coords_sel = jnp.moveaxis(coords, 0, 1).reshape(
+                B, M, -1, 3
+            ) + params["centers"][None, :, None, :]
+            selected = jnp.broadcast_to(
+                jnp.arange(M, dtype=jnp.int32)[None], (B, M)
+            )
+            kept = jnp.ones((B, M), bool)
+        else:
+            selected = select_topk_experts(logits, k)  # (B, K) ascending
+            kept, pos, slot_frame, _ = route_frames_to_experts(
+                selected, M, cap
+            )
+            blocks = imgs[slot_frame]  # (M, C, H, W, 3)
+            coords_b = jax.vmap(expert.apply)(params["expert"], blocks)
+            coords_b = coords_b.reshape(M, cap, -1, 3) \
+                + params["centers"][:, None, None, :]
+            # Scatter back: frame b's slot j holds its selected expert's
+            # block row.  Dropped pairs gather a clipped (wrong) row —
+            # finite garbage that esac_infer_routed_frames -inf-masks.
+            coords_sel = coords_b[selected, jnp.minimum(pos, cap - 1)]
+        f_b = jnp.broadcast_to(
+            jnp.asarray(params["f"], jnp.float32), (B,)
+        )
+        px_b = jnp.broadcast_to(pixels[None], (B,) + pixels.shape)
+        return esac_infer_routed_frames(
+            batch["key"], logits, coords_sel, selected, kept, px_b, f_b,
+            params["c"], cfg,
+        )
+
+    donate = (1,) if jax.default_backend() != "cpu" else ()
+    return jax.jit(run, donate_argnums=donate)
+
+
 class SceneRegistry:
     """Manifest + device weight cache + per-bucket compiled programs.
 
@@ -189,22 +304,38 @@ class SceneRegistry:
         self._fns: dict = {}
         self._fns_lock = threading.Lock()
 
-    def _fn_for(self, entry: SceneEntry):
-        key = entry.bucket_key()
+    def _fn_for(self, entry: SceneEntry, route_k: int | None = None):
+        """The compiled program serving ``entry``: dense when ``route_k``
+        is None (and the scene's cfg sets no ``serve_topk``), else the
+        gating-first routed program for top-``route_k`` experts.  Programs
+        are cached per (bucket key, K) — scenes sharing preset+cfg share
+        every routed program too, so hot-swap stays recompile-free at
+        every K."""
+        if route_k is None and entry.ransac.serve_topk > 0:
+            route_k = entry.ransac.serve_topk
+        key = (entry.bucket_key(), route_k)
         with self._fns_lock:
             fn = self._fns.get(key)
             if fn is None:
-                fn = make_scene_bucket_fn(entry.preset, entry.ransac)
+                fn = (
+                    make_scene_bucket_fn(entry.preset, entry.ransac)
+                    if route_k is None
+                    else make_routed_scene_bucket_fn(
+                        entry.preset, entry.ransac, route_k
+                    )
+                )
                 self._fns[key] = fn
             return fn
 
     def infer_fn(self):
-        """The dispatcher-facing callable: ``fn(batch, scene)``."""
+        """The dispatcher-facing callable: ``fn(batch, scene[, route_k])``
+        — ``route_k`` selects the top-K routed program for the dispatch
+        (None = the scene's default: dense, or ``cfg.serve_topk``)."""
 
-        def serve(batch, scene):
+        def serve(batch, scene, route_k=None):
             entry = self.manifest.resolve(scene)
             params = self.cache.get(entry)
-            return self._fn_for(entry)(params, batch)
+            return self._fn_for(entry, route_k)(params, batch)
 
         serve._cache_size = self.compile_cache_size
         return serve
@@ -251,7 +382,17 @@ def make_registry_sharded_serve_fn(
 
     infer = make_esac_infer_sharded_frames_dynamic(mesh, cfg)
 
-    def serve(batch, scene):
+    def serve(batch, scene, route_k=None):
+        if route_k is not None:
+            # Routing decides which expert CNNs RUN; this path receives
+            # precomputed coords_all, so there is nothing left to route.
+            # Fail precisely instead of with a dispatcher TypeError.
+            raise ValueError(
+                "route_k is not supported on the coords-level sharded "
+                "registry path (expert CNNs run upstream); use "
+                "parallel.make_esac_infer_routed_frames_sharded for "
+                "image-level routed sharded serving"
+            )
         entry = registry.manifest.resolve(scene)
         params = registry.cache.get(entry)
         return infer(batch, params["c"])

@@ -24,13 +24,22 @@ program compiles per bucket and the compile count is observable
 (``cache_size``, pinned by tests/test_serve.py).
 
 Multi-scene serving (esac_tpu.registry): every request may carry a
-``scene`` key.  Requests coalesce per (scene, frame-bucket) — a dispatch
-is always single-scene, because the scene decides which weights ride the
-program — and the worker round-robins across scenes with pending work, so
-a hot scene cannot starve a cold one.  Scene-carrying dispatches call
-``infer_fn(tree, scene)`` (the registry's serve fn resolves weights from
-its device cache per dispatch); scene-less requests keep the original
-``infer_fn(tree)`` contract, byte-for-byte.
+``scene`` key and, for gating-first routed serving (DESIGN.md §11), a
+``route_k`` top-K value.  Requests coalesce per (scene, route_k,
+frame-bucket) lane — a dispatch is always single-scene, because the scene
+decides which weights ride the program, and single-K, because K is a
+STATIC argument of the routed programs — and the worker round-robins
+across lanes with pending work, so a hot lane cannot starve a cold one.
+Scene-carrying dispatches call ``infer_fn(tree, scene)`` (the registry's
+serve fn resolves weights from its device cache per dispatch), routed
+ones ``infer_fn(tree, scene, route_k)``; scene-less requests keep the
+original ``infer_fn(tree)`` contract, byte-for-byte.
+
+Every stat the dispatcher keeps (latencies, dispatch/scene/route logs) is
+a ring buffer sized by ``stats_window``; the per-lane ``dispatch_counts``
+totals are keyed by (scene, route_k), bounded by the fleet, not by
+traffic — a week-long server's host memory stays flat (regression-pinned
+in tests/test_serve.py).
 """
 
 from __future__ import annotations
@@ -49,11 +58,13 @@ from esac_tpu.serve.batching import (
 
 
 class _Request:
-    __slots__ = ("frame", "scene", "event", "result", "error", "t_submit")
+    __slots__ = ("frame", "scene", "route_k", "event", "result", "error",
+                 "t_submit")
 
-    def __init__(self, frame, t_submit, scene=None):
+    def __init__(self, frame, t_submit, scene=None, route_k=None):
         self.frame = frame
         self.scene = scene
+        self.route_k = route_k
         self.event = threading.Event()
         self.result = None
         self.error = None
@@ -77,7 +88,10 @@ class MicroBatchDispatcher:
         cfg: RansacConfig = RansacConfig(),
         start_worker: bool = True,
         clock=time.perf_counter,
+        stats_window: int = 10_000,
     ):
+        if stats_window < 1:
+            raise ValueError(f"stats_window {stats_window} < 1")
         self._infer = infer_fn
         self._buckets = tuple(sorted(set(cfg.frame_buckets)))
         self._max_wait_s = cfg.serve_max_wait_ms / 1e3
@@ -86,25 +100,41 @@ class MicroBatchDispatcher:
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)   # waiters: worker
         self._space = threading.Condition(self._lock)  # waiters: submitters
-        # Per-scene queues in round-robin order (scene None = the legacy
-        # single-scene mode); a dispatch never mixes scenes.
-        self._pending: "collections.OrderedDict[object, collections.deque[_Request]]" = (
+        # Per-(scene, route_k) lane queues in round-robin order (lane
+        # (None, None) = the legacy single-scene mode); a dispatch never
+        # mixes scenes — the scene decides the weights — and never mixes
+        # route_k values, because K is a STATIC arg of the routed programs:
+        # one dispatch rides exactly one compiled program.
+        self._pending: "collections.OrderedDict[tuple, collections.deque[_Request]]" = (
             collections.OrderedDict()
         )
         self._n_pending = 0
         self._closed = False
-        # Bounded stats: a serving process runs for days — unbounded lists
-        # would leak and latency_quantiles() would sort the whole history
-        # under the dispatch lock.  Quantiles are over the recent window.
+        # Bounded stats: a serving process runs for days — EVERY per-request
+        # and per-dispatch record here is a ring buffer, sized by
+        # ``stats_window`` dispatches, or latency_quantiles() would sort an
+        # unbounded history under the dispatch lock and host memory would
+        # grow without limit (pinned by the long-stream regression test in
+        # tests/test_serve.py).  Quantiles are over the recent window; the
+        # only unbounded-looking structure left is ``dispatch_counts``,
+        # which is keyed by (scene, route_k) lane and therefore bounded by
+        # the fleet's scene count, not by traffic.
         self.latencies_s: collections.deque[float] = collections.deque(
-            maxlen=100_000
+            maxlen=10 * stats_window
         )
         self.dispatch_log: collections.deque[tuple[int, int]] = (
-            collections.deque(maxlen=10_000)  # (bucket, n_valid)
+            collections.deque(maxlen=stats_window)  # (bucket, n_valid)
         )
-        # Scene of each dispatch, aligned with dispatch_log (None entries
-        # for scene-less traffic) — the fairness tests zip the two.
-        self.scene_log: collections.deque = collections.deque(maxlen=10_000)
+        # Scene / route_k of each dispatch, aligned with dispatch_log (None
+        # entries for scene-less / dense traffic) — fairness tests zip them.
+        self.scene_log: collections.deque = collections.deque(
+            maxlen=stats_window
+        )
+        self.route_log: collections.deque = collections.deque(
+            maxlen=stats_window
+        )
+        # Lifetime totals per lane (fairness monitoring without a log).
+        self.dispatch_counts: collections.Counter = collections.Counter()
         self._worker = None
         if start_worker:
             self.start()
@@ -123,38 +153,40 @@ class MicroBatchDispatcher:
 
     # ---------------- request path ----------------
 
-    def submit(self, frame: dict, scene=None) -> _Request:
-        """Enqueue one frame tree (optionally for a registry ``scene``);
-        returns a request whose ``event`` fires when ``result`` (or
-        ``error``) is set.  Blocks for queue space — backpressure across
-        ALL scenes, never drops."""
-        req = _Request(frame, self._clock(), scene)
+    def submit(self, frame: dict, scene=None, route_k=None) -> _Request:
+        """Enqueue one frame tree (optionally for a registry ``scene`` and
+        a routed top-K program ``route_k``); returns a request whose
+        ``event`` fires when ``result`` (or ``error``) is set.  Blocks for
+        queue space — backpressure across ALL lanes, never drops."""
+        req = _Request(frame, self._clock(), scene, route_k)
+        lane = (scene, route_k)
         with self._work:
             while self._n_pending >= self._depth and not self._closed:
                 self._space.wait()
             if self._closed:
                 raise RuntimeError("dispatcher is closed")
-            q = self._pending.get(scene)
+            q = self._pending.get(lane)
             if q is None:
-                q = self._pending[scene] = collections.deque()
+                q = self._pending[lane] = collections.deque()
             q.append(req)
             self._n_pending += 1
             self._work.notify()
         return req
 
-    def infer_one(self, frame: dict, scene=None) -> dict:
+    def infer_one(self, frame: dict, scene=None, route_k=None) -> dict:
         """Blocking single-frame inference through the batching queue."""
         if self._worker is None:
-            req = _Request(frame, self._clock(), scene)
-            self._run([req], scene)
+            req = _Request(frame, self._clock(), scene, route_k)
+            self._run([req], scene, route_k)
         else:
-            req = self.submit(frame, scene)
+            req = self.submit(frame, scene, route_k)
             req.event.wait()
         if req.error is not None:
             raise req.error
         return req.result
 
-    def infer_many(self, frames: list[dict], scene=None) -> list[dict]:
+    def infer_many(self, frames: list[dict], scene=None,
+                   route_k=None) -> list[dict]:
         """Bulk inference: bucket-planned dispatches, staging double-buffered
         against in-flight compute.  Returns per-frame result trees (host
         numpy), in input order."""
@@ -179,18 +211,18 @@ class MicroBatchDispatcher:
         staged = stage(*bounds[0])
         for i in range(len(bounds)):
             tree, n_valid = staged
-            out = self._call(tree, scene)  # async dispatch: compute starts
+            # async dispatch: compute starts
+            out = self._call(tree, scene, route_k)
             if i + 1 < len(bounds):
                 staged = stage(*bounds[i + 1])  # host staging overlaps compute
             out = jax.block_until_ready(out)
             t_done = self._clock()
             host = jax.tree.map(np.asarray, out)
             with self._lock:
-                self.dispatch_log.append(
-                    (pick_bucket(n_valid, self._buckets), n_valid)
+                self._record(
+                    pick_bucket(n_valid, self._buckets), n_valid, scene,
+                    route_k, [t_done - t_submit] * n_valid,
                 )
-                self.scene_log.append(scene)
-                self.latencies_s.extend([t_done - t_submit] * n_valid)
             results.extend(
                 jax.tree.map(lambda x: x[j], host) for j in range(n_valid)
             )
@@ -198,13 +230,24 @@ class MicroBatchDispatcher:
 
     # ---------------- worker ----------------
 
-    def _call(self, tree, scene):
+    def _call(self, tree, scene, route_k=None):
         """Invoke the entry point: scene-carrying dispatches pass the scene
-        through (registry serve fns take ``(tree, scene)``); legacy
-        traffic keeps the one-argument contract."""
+        (and, for routed programs, ``route_k``) through — registry serve
+        fns take ``(tree, scene[, route_k])``; legacy traffic keeps the
+        one-argument contract byte-for-byte."""
+        if route_k is not None:
+            return self._infer(tree, scene, route_k)
         if scene is None:
             return self._infer(tree)
         return self._infer(tree, scene)
+
+    def _record(self, bucket, n_valid, scene, route_k, latencies):
+        """Append one dispatch to the bounded stat rings (lock held)."""
+        self.dispatch_log.append((bucket, n_valid))
+        self.scene_log.append(scene)
+        self.route_log.append(route_k)
+        self.dispatch_counts[(scene, route_k)] += 1
+        self.latencies_s.extend(latencies)
 
     def _worker_loop(self):
         big = self._buckets[-1]
@@ -214,10 +257,10 @@ class MicroBatchDispatcher:
                     self._work.wait()
                 if not self._n_pending:
                     return  # closed and drained
-                # Fairness: serve the scene at the head of the round-robin
+                # Fairness: serve the lane at the head of the round-robin
                 # order; if it still has pending work afterwards it moves to
-                # the back, so a flooding scene cannot starve the others.
-                scene, q = next(iter(self._pending.items()))
+                # the back, so a flooding lane cannot starve the others.
+                lane, q = next(iter(self._pending.items()))
                 deadline = q[0].t_submit + self._max_wait_s
                 while len(q) < big and not self._closed:
                     remaining = deadline - self._clock()
@@ -231,21 +274,21 @@ class MicroBatchDispatcher:
                 batch = [q.popleft() for _ in range(take)]
                 self._n_pending -= take
                 if q:
-                    self._pending.move_to_end(scene)
+                    self._pending.move_to_end(lane)
                 else:
-                    del self._pending[scene]
+                    del self._pending[lane]
                 self._space.notify_all()
-            self._run(batch, scene)
+            self._run(batch, *lane)
 
-    def _run(self, reqs: list[_Request], scene=None):
+    def _run(self, reqs: list[_Request], scene=None, route_k=None):
         try:
-            self._dispatch(reqs, scene)
+            self._dispatch(reqs, scene, route_k)
         except Exception as e:  # noqa: BLE001 — fan the failure out
             for r in reqs:
                 r.error = e
                 r.event.set()
 
-    def _dispatch(self, reqs: list[_Request], scene=None):
+    def _dispatch(self, reqs: list[_Request], scene=None, route_k=None):
         import jax
         import numpy as np
 
@@ -253,14 +296,13 @@ class MicroBatchDispatcher:
         padded, n_valid = pad_batch(
             stack_frames([r.frame for r in reqs]), bucket
         )
-        out = self._call(jax.device_put(padded), scene)
+        out = self._call(jax.device_put(padded), scene, route_k)
         out = jax.block_until_ready(out)
         t_done = self._clock()
         host = jax.tree.map(np.asarray, out)
         with self._lock:
-            self.dispatch_log.append((bucket, n_valid))
-            self.scene_log.append(scene)
-            self.latencies_s.extend(t_done - r.t_submit for r in reqs)
+            self._record(bucket, n_valid, scene, route_k,
+                         [t_done - r.t_submit for r in reqs])
         for i, r in enumerate(reqs):
             r.result = jax.tree.map(lambda x: x[i], host)
             r.event.set()
@@ -280,6 +322,8 @@ class MicroBatchDispatcher:
             self.latencies_s.clear()
             self.dispatch_log.clear()
             self.scene_log.clear()
+            self.route_log.clear()
+            self.dispatch_counts.clear()
 
     def cache_size(self) -> int | None:
         """Compiled-program count of the jitted entry point (None when the

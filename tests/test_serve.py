@@ -229,6 +229,41 @@ def test_esac_padded_batch_bit_identical_to_per_frame():
         assert int(got["expert"]) == int(want["expert"])
 
 
+def test_stats_stay_bounded_over_long_request_streams():
+    """A week-long server's host memory must stay flat: every stat the
+    dispatcher keeps is a ring buffer sized by ``stats_window`` (the
+    lifetime totals are scalars / per-lane counters bounded by the fleet),
+    and drained lanes leave nothing behind in the pending table."""
+    def fake_infer(tree, scene=None, route_k=None):
+        return {"echo": tree["x"]}
+
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,))
+    disp = MicroBatchDispatcher(fake_infer, cfg, start_worker=False,
+                                stats_window=50)
+    n = 2000
+    for i in range(n):
+        disp.infer_one({"x": np.zeros(2, np.float32)},
+                       scene=f"s{i % 3}", route_k=(i % 2) or None)
+    # rings hold exactly the window, not the history
+    assert len(disp.dispatch_log) == 50
+    assert len(disp.scene_log) == 50
+    assert len(disp.route_log) == 50
+    assert len(disp.latencies_s) == 500  # 10x window of per-request samples
+    # totals survive in bounded form: one counter per (scene, route_k) lane
+    assert sum(disp.dispatch_counts.values()) == n
+    assert set(disp.dispatch_counts) == {
+        (f"s{s}", k) for s in range(3) for k in (1, None)
+    }
+    # nothing accumulates in the lane table once drained
+    assert not disp._pending and disp._n_pending == 0
+    # quantiles keep working over the window
+    q = disp.latency_quantiles()
+    assert all(v >= 0.0 for v in q.values())
+    with pytest.raises(ValueError):
+        MicroBatchDispatcher(fake_infer, cfg, start_worker=False,
+                             stats_window=0)
+
+
 # ---------------- heavy legs: excluded from tier-1 ----------------
 
 @pytest.mark.slow

@@ -1,0 +1,459 @@
+"""Gating-first routed serve tests (ISSUE 5 acceptance).
+
+The load-bearing claims:
+
+- **K=M is the dense path, bitwise**: the routed bucket program at
+  ``k == num_experts`` reproduces ``make_scene_bucket_fn`` bit-for-bit
+  (identity routing statically specializes to the dense CNN schedule, and
+  the routed hypothesis loop's global-index RNG reduces to the dense
+  streams exactly);
+- **bucket invariance extends to routing**: a routed request's result is
+  bit-identical whichever frame bucket it rides, because the per-expert
+  frame capacity is one constant per (cfg, K) — never a function of the
+  bucket — and tail padding can only claim capacity BEHIND every real
+  frame (frame-index drop priority);
+- **overflow drops are accounted**: ``experts_evaluated`` carries the
+  sentinel M for capacity-dropped pairs, dropped experts can never win,
+  and the accounting agrees with ``parallel.esac_infer_routed`` /
+  ``make_esac_infer_routed_frames_sharded`` on comparable inputs;
+- **compile-once**: arbitrary multi-scene, multi-K traffic through one
+  dispatcher compiles each (bucket-key, K, frame-bucket) program exactly
+  once — hot-swapping scenes through routed programs never recompiles;
+- **zero-pad leak, capacity dimension**: degenerate pad-lane images may
+  route anywhere (their gating logits are garbage) without flipping one
+  bit of a real lane's result.
+
+Everything tier-1 runs tiny (16x16 frames, 4x 2-channel experts, 8
+hypotheses); the sharded-agreement leg rides the 8-virtual-device mesh and
+is ``test_heavy_`` / ``slow``.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esac_tpu.models import ExpertNet, GatingNet
+from esac_tpu.parallel.esac_sharded import route_frames_to_experts
+from esac_tpu.ransac import (
+    RansacConfig,
+    esac_infer_routed_frames,
+    routed_serve_capacity,
+    select_topk_experts,
+)
+from esac_tpu.registry import (
+    SceneEntry,
+    SceneManifest,
+    ScenePreset,
+    SceneRegistry,
+    make_routed_scene_bucket_fn,
+    make_scene_bucket_fn,
+)
+
+H = W = 16
+M = 4
+PRESET = ScenePreset(
+    height=H, width=W, num_experts=M,
+    stem_channels=(2, 2, 2), head_channels=2, head_depth=1,
+    gating_channels=(2,), compute_dtype="float32", gated=True,
+)
+CFG = RansacConfig(n_hyps=8, refine_iters=2, polish_iters=1,
+                   frame_buckets=(1, 4))
+POSE_KEYS = ("rvec", "tvec", "scores", "expert", "gating_probs",
+             "inlier_frac")
+
+
+def _params(seed):
+    expert = ExpertNet(
+        scene_center=(0.0, 0.0, 0.0), stem_channels=PRESET.stem_channels,
+        head_channels=PRESET.head_channels, head_depth=PRESET.head_depth,
+        compute_dtype=jnp.float32,
+    )
+    gating = GatingNet(num_experts=M, channels=PRESET.gating_channels,
+                       compute_dtype=jnp.float32)
+    img0 = jnp.zeros((1, H, W, 3))
+    return {
+        "expert": jax.vmap(lambda k: expert.init(k, img0))(
+            jax.random.split(jax.random.key(seed), M)
+        ),
+        "gating": gating.init(jax.random.key(seed + 100), img0),
+        "centers": jnp.asarray(
+            np.asarray([[0.0, 0.0, 2.0]], np.float32)
+            + np.arange(M, dtype=np.float32)[:, None] * 0.1 + seed * 0.01
+        ),
+        "c": jnp.asarray([W / 2.0, H / 2.0]),
+        "f": jnp.float32(20.0),
+    }
+
+
+@pytest.fixture(scope="module")
+def params():
+    return {"a": _params(0), "b": _params(1)}
+
+
+def _registry(params, scene_ids=("a",)):
+    """A registry over in-memory params (fake checkpoint paths; the custom
+    loader never touches disk) — the routed programs only care that
+    weights arrive as a device tree."""
+    m = SceneManifest()
+    for sid in scene_ids:
+        m.add(SceneEntry(
+            scene_id=sid, version=1, expert_ckpt="unused",
+            gating_ckpt="unused", preset=PRESET, ransac=CFG,
+        ))
+    return SceneRegistry(m, loader=lambda e: params[e.scene_id])
+
+
+def _frame(i):
+    return {
+        "key": jax.random.fold_in(jax.random.key(7), i),
+        "image": np.asarray(jax.random.uniform(
+            jax.random.fold_in(jax.random.key(42), i), (H, W, 3)
+        )),
+    }
+
+
+def _bitwise_equal(a, b, keys=POSE_KEYS):
+    return all(
+        np.array_equal(np.asarray(a[k]), np.asarray(b[k])) for k in keys
+    )
+
+
+# ---------------- routing primitives (pure shape logic) ----------------
+
+def test_select_topk_experts_sorted_ascending():
+    logits = jnp.asarray([[0.0, 3.0, -1.0, 2.0]])
+    assert select_topk_experts(logits, 2).tolist() == [[1, 3]]
+    assert select_topk_experts(logits, 4).tolist() == [[0, 1, 2, 3]]
+
+
+def test_routed_serve_capacity_rule():
+    cfg = RansacConfig(frame_buckets=(1, 4, 16))
+    # auto: ceil(2 * K * max_bucket / M), clamped to [2, max_bucket]
+    assert routed_serve_capacity(cfg, 2, 8) == 8
+    assert routed_serve_capacity(cfg, 1, 16) == 2
+    assert routed_serve_capacity(cfg, 16, 16) == 16    # clamp to bucket
+    # explicit capacity wins, same clamps
+    assert routed_serve_capacity(
+        dataclasses.replace(cfg, serve_capacity=5), 2, 8) == 5
+    assert routed_serve_capacity(
+        dataclasses.replace(cfg, serve_capacity=1), 2, 8) == 2
+    # bucket-independence: never a function of anything but cfg, K, M
+    assert routed_serve_capacity(cfg, 2, 8) == routed_serve_capacity(
+        dataclasses.replace(cfg, serve_max_wait_ms=99.0), 2, 8)
+
+
+def test_route_frames_to_experts_capacity_and_priority():
+    sel = jnp.asarray([[0, 2], [0, 1], [0, 2], [2, 3]], jnp.int32)
+    kept, pos, slot_frame, slot_valid = route_frames_to_experts(sel, 4, 2)
+    # expert 0 claimed by frames 0,1,2 -> 2 drops; expert 2 by 0,2,3 -> 3 drops
+    assert kept.tolist() == [[True, True], [True, True],
+                             [False, True], [False, True]]
+    assert slot_frame[0].tolist() == [0, 1]
+    assert slot_frame[2].tolist() == [0, 2]
+    assert slot_valid[1].tolist() == [True, False]
+    assert slot_valid[3].tolist() == [True, False]
+    # per-expert block occupancy never exceeds capacity
+    assert int(slot_valid.sum(axis=1).max()) <= 2
+
+
+def test_route_later_frames_never_displace_earlier():
+    """The bucket-invariance prerequisite: appending frames (tail padding
+    appends pads) must not change any earlier frame's kept/pos."""
+    key = jax.random.key(0)
+    sel = jnp.sort(jax.random.randint(key, (6, 2), 0, 3), axis=-1)
+    # make slots distinct within a frame (selected ids are distinct by
+    # construction from top_k; emulate)
+    sel = jnp.stack([sel[:, 0], sel[:, 1] + 1], axis=1).astype(jnp.int32)
+    kept, pos, _, _ = route_frames_to_experts(sel, 4, 2)
+    kept2, pos2, _, _ = route_frames_to_experts(
+        jnp.concatenate([sel, sel[:2]]), 4, 2
+    )
+    assert np.array_equal(np.asarray(kept2[:6]), np.asarray(kept))
+    assert np.array_equal(np.asarray(pos2[:6]), np.asarray(pos))
+
+
+# ---------------- the acceptance pins ----------------
+
+def test_k_eq_m_bit_identical_to_dense(params):
+    """THE acceptance pin: the routed program at K=M reproduces the dense
+    bucket program bit-for-bit, on every output the dense path has."""
+    dense = make_scene_bucket_fn(PRESET, CFG)
+    routed = make_routed_scene_bucket_fn(PRESET, CFG, M)
+    batch = {
+        "key": jax.random.split(jax.random.key(2), 4),
+        "image": jnp.stack([jnp.asarray(_frame(i)["image"])
+                            for i in range(4)]),
+    }
+    out_d = jax.block_until_ready(dense(params["a"], batch))
+    out_r = jax.block_until_ready(routed(params["a"], batch))
+    assert _bitwise_equal(out_d, out_r)
+    # identity routing: everything evaluated, nothing dropped
+    assert np.array_equal(np.asarray(out_r["experts_evaluated"]),
+                          np.tile(np.arange(M), (4, 1)))
+
+
+def test_routed_bit_identical_across_frame_buckets(params):
+    """Extended bit-parity contract: a routed request's result does not
+    depend on which frame bucket it rides — the capacity dimension is one
+    constant per (cfg, K), so padding can't change who survives."""
+    reg = _registry(params)
+    disp = reg.dispatcher(CFG, start_worker=False)
+    frames = [_frame(i) for i in range(3)]
+    bulk = disp.infer_many(frames, scene="a", route_k=2)     # 4-bucket
+    singles = [disp.infer_one(f, scene="a", route_k=2) for f in frames]
+    for got, want in zip(bulk, singles):
+        assert _bitwise_equal(got, want)
+        assert np.array_equal(got["experts_evaluated"],
+                              want["experts_evaluated"])
+
+
+def test_capacity_overflow_drops_accounted_and_cannot_win(params):
+    """All frames share one image -> identical gating -> every frame
+    contends for the SAME experts; with capacity 2 and 4 frames, frames
+    2..3 lose every slot (frame-index priority).  The drops surface as the
+    sentinel M in experts_evaluated, dropped frames still return finite
+    poses, and the surviving frames' results are untouched."""
+    cfg = dataclasses.replace(CFG, serve_capacity=2)
+    routed = make_routed_scene_bucket_fn(PRESET, cfg, 2)
+    img = jnp.asarray(_frame(0)["image"])
+    batch = {
+        "key": jax.random.split(jax.random.key(5), 4),
+        "image": jnp.tile(img[None], (4, 1, 1, 1)),
+    }
+    out = jax.block_until_ready(routed(params["a"], batch))
+    ev = np.asarray(out["experts_evaluated"])
+    # budget reallocation: K=2 of M=4 -> each evaluated expert runs 2x hyps
+    assert out["scores"].shape == (4, 2, CFG.n_hyps * M // 2)
+    assert (ev[:2] < M).all(), "first-in frames keep their experts"
+    assert (ev[2:] == M).all(), "overflow frames dropped every slot"
+    assert np.isfinite(np.asarray(out["rvec"])).all()
+    assert np.isfinite(np.asarray(out["tvec"])).all()
+    # dropped slots can never win: the masked scores are -inf
+    assert np.isneginf(np.asarray(out["scores"][2:])).all()
+    # survivors bit-match a 2-frame dispatch of the same leading frames
+    # (the overflow frames' presence changed nothing for the frames that
+    # beat them to the capacity slots)
+    out2 = jax.block_until_ready(routed(params["a"], {
+        "key": batch["key"][:2], "image": batch["image"][:2],
+    }))
+    for k in POSE_KEYS:
+        assert np.array_equal(np.asarray(out[k])[:2], np.asarray(out2[k]))
+
+
+def test_zero_pad_cannot_leak_into_real_lanes_capacity_dim(params):
+    """Zero-pad leak, capacity dimension: an all-zero pad image routes by
+    its own garbage logits and occupies capacity slots — but only BEHIND
+    every real frame, so real lanes' bits never move."""
+    routed = make_routed_scene_bucket_fn(PRESET, CFG, 2)
+    frames = [_frame(10 + i) for i in range(3)]
+    keys = jax.random.split(jax.random.key(6), 4)
+    imgs = jnp.stack([jnp.asarray(f["image"]) for f in frames])
+    pad_repeat = jnp.concatenate([imgs, imgs[-1:]])       # serve-path pad
+    pad_zero = jnp.concatenate([imgs, jnp.zeros_like(imgs[-1:])])
+    out_r = jax.block_until_ready(
+        routed(params["a"], {"key": keys, "image": pad_repeat})
+    )
+    out_z = jax.block_until_ready(
+        routed(params["a"], {"key": keys, "image": pad_zero})
+    )
+    for k in POSE_KEYS + ("experts_evaluated",):
+        assert np.array_equal(np.asarray(out_r[k])[:3],
+                              np.asarray(out_z[k])[:3])
+
+
+def test_hot_swap_multi_k_compiles_once_per_program(params):
+    """Jit cache-miss counter: two scenes hot-swapped through one
+    dispatcher across dense + two K values and both frame buckets compile
+    each (bucket-key, K, frame-bucket) program EXACTLY once — and the
+    routed programs serve both scenes without recompiling."""
+    reg = _registry(params, scene_ids=("a", "b"))
+    disp = reg.dispatcher(CFG, start_worker=False)
+    frames = [_frame(20 + i) for i in range(3)]
+    results = {}
+    for sid in ("a", "b"):
+        for k in (None, 2, M):
+            results[(sid, k, "one")] = disp.infer_one(
+                frames[0], scene=sid, route_k=k)
+            results[(sid, k, "many")] = disp.infer_many(
+                frames, scene=sid, route_k=k)[0]
+    # 3 program families (dense, K=2, K=M) x 2 frame buckets, regardless
+    # of scene count:
+    assert disp.cache_size() == 3 * len(set(CFG.frame_buckets))
+    for sid in ("a", "b"):
+        for k in (None, 2, M):
+            assert _bitwise_equal(results[(sid, k, "one")],
+                                  results[(sid, k, "many")])
+        # K=M rides the dense schedule: bit-identical to dense traffic
+        assert _bitwise_equal(results[(sid, None, "one")],
+                              results[(sid, M, "one")])
+    # scenes genuinely serve different weights through the routed program
+    assert not np.array_equal(results[("a", 2, "one")]["rvec"],
+                              results[("b", 2, "one")]["rvec"])
+
+
+def test_dispatcher_never_mixes_route_k_lanes():
+    """K is a static arg of the routed programs: queued traffic with mixed
+    route_k must split into per-(scene, K) dispatches, round-robin."""
+    calls = []
+
+    def fake_infer(tree, scene=None, route_k=None):
+        calls.append((scene, route_k, len(tree["x"])))
+        return {"echo": tree["x"]}
+
+    from esac_tpu.serve import MicroBatchDispatcher
+
+    disp = MicroBatchDispatcher(fake_infer, CFG, start_worker=False)
+    reqs = []
+    for i in range(2):
+        reqs.append(disp.submit({"x": np.zeros(3)}, scene="a", route_k=2))
+        reqs.append(disp.submit({"x": np.zeros(3)}, scene="a"))
+    disp.start()
+    for r in reqs:
+        assert r.event.wait(120.0)
+    disp.close()
+    assert list(disp.scene_log) == ["a", "a"]
+    assert list(disp.route_log) == [2, None]
+    assert list(disp.dispatch_log) == [(4, 2), (4, 2)]
+    assert disp.dispatch_counts == {("a", 2): 1, ("a", None): 1}
+    # the routed lane reached the infer fn with its K; the dense lane
+    # kept the two-argument registry contract
+    assert calls[0][:2] == ("a", 2) and calls[1][:2] == ("a", None)
+
+
+def test_coords_level_sharded_registry_rejects_route_k(params):
+    """The coords-level sharded registry path receives precomputed
+    coords_all — there is nothing left to route.  A route_k request must
+    fail with a precise error, not a dispatcher-arity TypeError."""
+    from esac_tpu.parallel import make_mesh
+    from esac_tpu.registry import make_registry_sharded_serve_fn
+
+    reg = _registry(params)
+    fn = make_registry_sharded_serve_fn(make_mesh(n_data=2, n_expert=4),
+                                        reg, CFG)
+    with pytest.raises(ValueError, match="route_k is not supported"):
+        fn({"key": None}, "a", 2)
+
+
+def test_routed_frames_budget_floor():
+    """K > n_hyps * M edge: the per-expert budget floors at 1 hypothesis,
+    never 0 (a zero-hypothesis expert would be an empty argmax)."""
+    B, Mx, K = 2, 4, 3
+    cfg = RansacConfig(n_hyps=1, refine_iters=1, polish_iters=1)
+    key = jax.random.key(0)
+    coords = jax.random.uniform(key, (B, K, 16, 3), minval=-1.0, maxval=1.0)
+    pixels = jax.random.uniform(jax.random.key(1), (B, 16, 2), maxval=64.0)
+    out = esac_infer_routed_frames(
+        jax.random.split(key, B), jnp.zeros((B, Mx)), coords,
+        jnp.tile(jnp.asarray([0, 1, 2], jnp.int32)[None], (B, 1)),
+        jnp.ones((B, K), bool), pixels, jnp.full((B,), 60.0),
+        jnp.asarray([32.0, 24.0]), cfg,
+    )
+    assert out["scores"].shape == (B, K, max(1, 1 * Mx // K))
+    assert np.isfinite(np.asarray(out["rvec"])).all()
+
+
+# ---------------- heavy leg: sharded agreement ----------------
+
+@pytest.mark.slow
+def test_heavy_sharded_routed_serve_agrees_with_single_chip():
+    """The expert-sharded routed serve path (shared capacity-dispatch
+    helper + _winner_allreduce) must agree with the single-chip routed
+    entry on the same inputs: identical experts_evaluated accounting,
+    identical winner, poses to float tolerance — and, with the gating mass
+    arranged one-top-expert-per-shard, its evaluated sets must equal
+    ``esac_infer_routed``'s (the original MoE-capacity path)."""
+    from esac_tpu.data import CAMERA_F, make_correspondence_frame
+    from esac_tpu.parallel import (
+        esac_infer_routed,
+        make_esac_infer_routed_frames_sharded,
+        make_mesh,
+    )
+
+    F = jnp.float32(CAMERA_F / 4.0)
+    C = jnp.asarray([80.0, 60.0])
+    cfg = RansacConfig(n_hyps=8, refine_iters=2, polish_iters=1,
+                       frame_buckets=(4,))
+    Mx, B, K = 8, 3, 4
+    frame = make_correspondence_frame(
+        jax.random.key(0), noise=0.01, height=120, width=160,
+        f=CAMERA_F / 4.0, c=(80.0, 60.0),
+    )
+    n = frame["coords"].shape[0]
+    h, w = 15, 20
+    maps = jnp.stack([
+        frame["coords"] if m == 2 else jax.random.uniform(
+            jax.random.fold_in(jax.random.key(1), m), (n, 3), maxval=5.0)
+        for m in range(Mx)
+    ])
+
+    def apply_fn(p, images):
+        return jnp.broadcast_to(
+            p.reshape(1, h, w, 3), (images.shape[0], h, w, 3)
+        )
+
+    centers = jnp.zeros((Mx, 3))
+    # top-4 = {0, 2, 4, 6}: exactly one per 4-shard -> comparable to
+    # esac_infer_routed at capacity 1 (its capacity axis is local experts)
+    logits = jnp.tile(
+        jnp.asarray([2.0, -3.0, 5.0, -3.0, 1.0, -4.0, 0.5, -5.0])[None],
+        (B, 1),
+    )
+    keys = jax.random.split(jax.random.key(9), B)
+    images = jnp.zeros((B, 4, 4, 3))
+    focals = jnp.full((B,), F)
+    mesh = make_mesh(n_data=2, n_expert=4)
+
+    out_sh = make_esac_infer_routed_frames_sharded(
+        mesh, apply_fn, maps, centers, cfg, k=K
+    )(keys, logits, images, focals, frame["pixels"], C)
+
+    cap = routed_serve_capacity(cfg, K, Mx)
+    selected = select_topk_experts(logits, K)
+    kept, pos, _, _ = route_frames_to_experts(selected, Mx, cap)
+    out_1 = esac_infer_routed_frames(
+        keys, logits, maps[selected], selected, kept,
+        jnp.broadcast_to(frame["pixels"][None], (B,) + frame["pixels"].shape),
+        focals, C, cfg,
+    )
+    assert np.array_equal(out_sh["experts_evaluated"],
+                          out_1["experts_evaluated"])
+    assert np.array_equal(out_sh["expert"], out_1["expert"])
+    assert np.asarray(out_sh["expert"]).tolist() == [2] * B
+    np.testing.assert_allclose(out_sh["rvec"], out_1["rvec"], atol=1e-4)
+    np.testing.assert_allclose(out_sh["tvec"], out_1["tvec"], atol=1e-4)
+    np.testing.assert_allclose(
+        out_sh["score"], np.max(np.asarray(out_1["scores"]), axis=(1, 2)),
+        rtol=1e-6,
+    )
+
+    out_old = esac_infer_routed(
+        mesh, apply_fn, maps, centers, capacity=1, cfg=cfg
+    )(jax.random.key(3), logits, images, focals, frame["pixels"], C)
+    assert np.array_equal(
+        np.sort(np.asarray(out_old["experts_evaluated"]), axis=1),
+        np.sort(np.asarray(out_sh["experts_evaluated"]), axis=1),
+    )
+
+    # Total-drop corner: capacity 2 under identical gating drops EVERY
+    # slot of frame 2 — the sharded path must still report a real
+    # in-range expert id (sel[0], the single-chip failed-frame output),
+    # with exactly one shard's finite pose surviving the all-reduce.
+    out_drop = make_esac_infer_routed_frames_sharded(
+        mesh, apply_fn, maps, centers, cfg, k=K, capacity=2
+    )(keys, logits, images, focals, frame["pixels"], C)
+    kept2, _, _, _ = route_frames_to_experts(selected, Mx, 2)
+    out_drop1 = esac_infer_routed_frames(
+        keys, logits, maps[selected], selected, kept2,
+        jnp.broadcast_to(frame["pixels"][None], (B,) + frame["pixels"].shape),
+        focals, C, cfg,
+    )
+    ev2 = np.asarray(out_drop["experts_evaluated"])
+    assert (ev2[2] == Mx).all(), "frame 2 loses every slot at capacity 2"
+    assert np.array_equal(ev2, np.asarray(out_drop1["experts_evaluated"]))
+    assert np.array_equal(out_drop["expert"], out_drop1["expert"])
+    assert int(out_drop["expert"][2]) == int(selected[2, 0])  # in range
+    assert np.isfinite(np.asarray(out_drop["rvec"])).all()
+    assert np.isfinite(np.asarray(out_drop["tvec"])).all()
