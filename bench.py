@@ -474,7 +474,9 @@ def _measure_routed(
         # Fresh device tree per call: the bucket programs DONATE the batch
         # on accelerators (registry donation policy), so reusing one tree
         # would crash the TPU leg after its first dispatch; per-dispatch
-        # staging is also the honest serving cost.
+        # staging is also the honest serving cost.  (The reuse bug this
+        # replaced is now machine-checked: graft-lint R8 flags a donated
+        # tree staged outside the timing loop.)
         return {
             "key": jax.random.split(jax.random.key(2), B),
             "image": jax.device_put(host_images),

@@ -8,21 +8,29 @@ no scalar-looping linalg in vmapped hot paths, force-CPU guards in ad-hoc
 scripts, and never ``timeout``/``kill`` on a jax-on-TPU process.  This
 package checks them statically, in two layers:
 
-- **Layer 1** (:mod:`esac_tpu.lint.ast_rules`, :mod:`~.shell_rules`):
-  pure-AST rules R1-R6 over Python sources plus a line rule R7 over shell
-  scripts.  No jax import, runs in well under a second.
+- **Layer 1** (:mod:`esac_tpu.lint.ast_rules`, :mod:`~.concurrency`,
+  :mod:`~.shell_rules`): pure-AST rules R1-R6 plus the graft-audit v2
+  rules — R8 donation safety, R9 retrace safety, R10 serve-layer lock
+  discipline, R11 jaxpr-audit registry coverage — and a line rule R7 over
+  shell scripts.  No jax import, runs in well under a second.
 - **Layer 2** (:mod:`esac_tpu.lint.jaxpr_audit`): jit-traces a registry of
   real entry points on the CPU backend and audits the jaxprs themselves —
   disallowed primitives, dynamic shapes, unpinned ``dot_general`` precision.
+- **Layer 2b** (:mod:`esac_tpu.lint.ledger`): the jaxpr resource ledger —
+  per-entry flops / peak intermediate bytes / dot-precision census over
+  the same traces, diffed against the committed ``.jaxpr_ledger.json``
+  (J4 regression gate; ``--write-ledger`` to regenerate).
 
 Run ``python -m esac_tpu.lint`` (full tree) or ``--changed`` (git-diff
-scoped).  Rules support inline ``# graft-lint: disable=RULE(reason)``
+scoped); ``--format json`` emits stable one-object-per-line findings for
+drivers.  Rules support inline ``# graft-lint: disable=RULE(reason)``
 suppressions and a committed ``lint_baseline.json`` for grandfathered
 findings.  See LINT.md for the rule catalog and workflow.
 """
 
 from esac_tpu.lint.findings import Finding, RULES
-from esac_tpu.lint.ast_rules import run_python_rules
+from esac_tpu.lint.ast_rules import run_python_rules, run_registry_coverage
+from esac_tpu.lint.concurrency import run_concurrency_rules
 from esac_tpu.lint.shell_rules import run_shell_rules
 from esac_tpu.lint.suppress import Baseline, filter_suppressed
 
@@ -31,6 +39,8 @@ __all__ = [
     "RULES",
     "run_python_rules",
     "run_shell_rules",
+    "run_concurrency_rules",
+    "run_registry_coverage",
     "Baseline",
     "filter_suppressed",
     "run_layer1",
@@ -39,7 +49,11 @@ __all__ = [
 
 def run_layer1(root, files=None):
     """All layer-1 findings for the tree at ``root`` (inline suppressions
-    already applied, baseline NOT applied — callers decide)."""
+    already applied, baseline NOT applied — callers decide).  Includes the
+    serve-layer concurrency rules (R10) and the registry coverage gate
+    (R11, tree-global whenever package files are in scope)."""
     findings = run_python_rules(root, files=files)
     findings += run_shell_rules(root, files=files)
+    findings += run_concurrency_rules(root, files=files)
+    findings += run_registry_coverage(root, files=files)
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

@@ -199,6 +199,20 @@ def _r3_scope(rel: str) -> bool:
     return rel.startswith("esac_tpu/")
 
 
+def _r8_scope(rel: str) -> bool:
+    # Donation misuse crashes wherever it happens (the PR-4 instance was in
+    # bench.py, not the package) — everything but tests/, which constructs
+    # adversarial trees on purpose.
+    return not _in_tests(rel)
+
+
+def _r9_scope(rel: str) -> bool:
+    # Retrace hazards matter where code runs repeatedly: the package.  Root
+    # scripts are one-shot trainers/probes whose single extra trace is not
+    # a serving regression.
+    return rel.startswith("esac_tpu/")
+
+
 # --------------------------------------------------------------------------
 # per-file rules
 
@@ -346,6 +360,322 @@ def _rule_r6(rel, tree, aliases, lines):
 
 
 # --------------------------------------------------------------------------
+# R8: donation safety / R9: retrace safety
+
+def _loop_walk(body, loops=()):
+    """Yield ``(node, loop_stack)`` over ``body`` without descending into
+    nested function/lambda scopes (they are analyzed as their own scopes)."""
+    for node in body:
+        yield node, loops
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        inner = loops + (node,) if isinstance(node, (ast.For, ast.While)) else loops
+        yield from _loop_walk(list(ast.iter_child_nodes(node)), inner)
+
+
+def _donate_positions(node, scope_values) -> set[int]:
+    """Resolve a ``donate_argnums=`` expression to a set of positions.
+
+    Handles int/tuple literals, one level of Name indirection into the same
+    scope, and the repo's conditional idiom
+    ``donate = (1,) if backend != "cpu" else ()`` (union of branches).
+    Unresolvable expressions yield the empty set — R8 under-approximates
+    rather than false-positive.
+    """
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.add(elt.value)
+        return out
+    if isinstance(node, ast.IfExp):
+        return _donate_positions(node.body, scope_values) | \
+            _donate_positions(node.orelse, scope_values)
+    if isinstance(node, ast.Name):
+        vals = scope_values.get(node.id, [])
+        if len(vals) == 1:
+            return _donate_positions(vals[0], {})
+        return set()
+    return set()
+
+
+def _jit_donate_call(node, aliases) -> ast.Call | None:
+    """The ``jax.jit(...)`` Call carrying a donate_argnums kwarg, or None."""
+    if not isinstance(node, ast.Call) or _dotted(node.func, aliases) != "jax.jit":
+        return None
+    for kw in node.keywords:
+        if kw.arg == "donate_argnums":
+            return node
+    return None
+
+
+def _is_cache_get(node, aliases) -> bool:
+    """True for ``<anything>.cache.get(...)`` / ``cache.get(...)`` — the
+    registry weight-cache access idiom (registry/cache.py invariant: cached
+    trees are reused across dispatches and must never be donated)."""
+    if not isinstance(node, ast.Call):
+        return False
+    dotted = _dotted(node.func, aliases) or ""
+    return dotted == "cache.get" or dotted.endswith(".cache.get")
+
+
+def _donating_factories(tree: ast.AST, aliases) -> dict[str, set[int]]:
+    """Top-level functions returning a donating ``jax.jit`` wrapper."""
+    out: dict[str, set[int]] = {}
+    for node in tree.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        values: dict[str, list] = {}
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name):
+                values.setdefault(sub.targets[0].id, []).append(sub.value)
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Return) or sub.value is None:
+                continue
+            call = _jit_donate_call(sub.value, aliases)
+            if call is None:
+                continue
+            for kw in call.keywords:
+                if kw.arg == "donate_argnums":
+                    pos = _donate_positions(kw.value, values)
+                    if pos:
+                        out[node.name] = pos
+    return out
+
+
+def _scopes(tree: ast.AST):
+    """Module body + every function body, each as its own analysis scope."""
+    yield tree.body
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.body
+
+
+def _rule_r8(rel, tree, aliases, lines):
+    factories = _donating_factories(tree, aliases)
+    out = []
+    for body in _scopes(tree):
+        assigns: dict[str, list] = {}        # name -> [(loops, line)]: ANY
+        #   binding site — plain assign, tuple unpack, for/with targets —
+        #   so `batch, labels = next(it)` and `for batch in it:` count as
+        #   restaging (reaching-def / loop-intersection inputs).
+        values: dict[str, list] = {}         # name -> [value] (single-target)
+        loads: dict[str, list] = {}          # name -> [(lineno, col)]
+        calls = []                           # (call, loops)
+        for node, loops in _loop_walk(body):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                values.setdefault(node.targets[0].id, []).append(node.value)
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    loads.setdefault(node.id, []).append(
+                        (node.lineno, node.col_offset)
+                    )
+                elif isinstance(node.ctx, ast.Store):
+                    assigns.setdefault(node.id, []).append(
+                        (loops, node.lineno)
+                    )
+            if isinstance(node, ast.Call):
+                calls.append((node, loops))
+
+        donating: dict[str, set[int]] = {}
+        cached: set[str] = set()
+        for name, vals in values.items():
+            for v in vals:
+                call = _jit_donate_call(v, aliases)
+                if call is not None:
+                    for kw in call.keywords:
+                        if kw.arg == "donate_argnums":
+                            pos = _donate_positions(kw.value, values)
+                            if pos:
+                                donating[name] = pos
+                if isinstance(v, ast.Call) and isinstance(v.func, ast.Name) \
+                        and v.func.id in factories:
+                    donating[name] = factories[v.func.id]
+                if _is_cache_get(v, aliases):
+                    cached.add(name)
+
+        if not donating:
+            continue
+        for call, loops in calls:
+            if not isinstance(call.func, ast.Name) or call.func.id not in donating:
+                continue
+            fn_name = call.func.id
+            for p in sorted(donating[fn_name]):
+                if p >= len(call.args):
+                    continue
+                arg = call.args[p]
+                if _is_cache_get(arg, aliases) or (
+                    isinstance(arg, ast.Name) and arg.id in cached
+                ):
+                    out.append(Finding(
+                        "R8", rel, call.lineno, _line_text(lines, call.lineno),
+                        f"donated position {p} of '{fn_name}' receives a "
+                        "cached/registry-held param tree: donation would "
+                        "silently invalidate the cache's device buffers for "
+                        "every later dispatch — donate only per-dispatch "
+                        "data (registry/cache.py invariant)",
+                    ))
+                    continue
+                if not isinstance(arg, ast.Name):
+                    continue
+                n = arg.id
+                if loops and not any(
+                    set(loops) & set(a_loops)
+                    for a_loops, _ln in assigns.get(n, [])
+                ):
+                    out.append(Finding(
+                        "R8", rel, call.lineno, _line_text(lines, call.lineno),
+                        f"'{n}' is staged once outside the loop but passed "
+                        f"in donated position {p} of '{fn_name}' every "
+                        "iteration: after the first dispatch its buffers "
+                        "are invalidated (the PR-4 bench bug) — restage a "
+                        "fresh tree per call",
+                    ))
+                    continue
+                # "Later use" means beyond the CALL's full span (a
+                # multi-line call's own argument load is not a reuse), and
+                # before any re-assignment of the name (a restaged tree is
+                # a new buffer — reaching-def cutoff).
+                call_end = getattr(call, "end_lineno", None) or call.lineno
+                next_assign = min(
+                    (ln for _l, ln in assigns.get(n, [])
+                     if ln > call_end),
+                    default=None,
+                )
+                if any(
+                    ln > call_end
+                    and (next_assign is None or ln < next_assign)
+                    for ln, _ in loads.get(n, [])
+                ):
+                    out.append(Finding(
+                        "R8", rel, call.lineno, _line_text(lines, call.lineno),
+                        f"'{n}' is used again after being passed in donated "
+                        f"position {p} of '{fn_name}': donation invalidates "
+                        "the buffers at the call — on accelerators any "
+                        "later use reads freed memory",
+                    ))
+    return out
+
+
+_UNHASHABLE_NODES = (
+    ast.Dict, ast.List, ast.Set, ast.ListComp, ast.SetComp, ast.DictComp,
+    ast.GeneratorExp,
+)
+
+
+def _static_positions_of_jitted_defs(tree, aliases):
+    """name -> (static positions, static argname->position) for same-module
+    functions decorated ``@partial(jax.jit, static_arg...)``."""
+    out: dict[str, tuple[set[int], dict[str, int]]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            if _dotted(dec.func, aliases) not in ("functools.partial", "partial"):
+                continue
+            if not (dec.args and _dotted(dec.args[0], aliases) == "jax.jit"):
+                continue
+            positions: set[int] = set()
+            by_name: dict[str, int] = {}
+            params = [a.arg for a in node.args.posonlyargs + node.args.args]
+            for kw in dec.keywords:
+                if kw.arg == "static_argnums":
+                    positions |= _donate_positions(kw.value, {})
+                elif kw.arg == "static_argnames" and isinstance(
+                    kw.value, (ast.Tuple, ast.List)
+                ):
+                    for elt in kw.value.elts:
+                        if isinstance(elt, ast.Constant) \
+                                and isinstance(elt.value, str):
+                            name = elt.value
+                            if name in params:
+                                by_name[name] = params.index(name)
+            if positions or by_name:
+                out[node.name] = (positions | set(by_name.values()), by_name)
+    return out
+
+
+def _rule_r9(rel, tree, aliases, lines):
+    out = []
+    static_map = _static_positions_of_jitted_defs(tree, aliases)
+
+    def is_jit_maker(node) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        dotted = _dotted(node.func, aliases)
+        if dotted == "jax.jit":
+            return True
+        return (
+            dotted in ("functools.partial", "partial")
+            and bool(node.args)
+            and _dotted(node.args[0], aliases) == "jax.jit"
+        )
+
+    for body in _scopes(tree):
+        for node, loops in _loop_walk(body):
+            if not isinstance(node, ast.Call):
+                continue
+            if is_jit_maker(node) and loops:
+                out.append(Finding(
+                    "R9", rel, node.lineno, _line_text(lines, node.lineno),
+                    "jit wrapper constructed inside a loop: each iteration "
+                    "builds a fresh callable with an empty compile cache "
+                    "(retrace + recompile per pass) — hoist the jax.jit out "
+                    "of the loop or cache the wrapper",
+                ))
+            elif not loops and isinstance(node.func, ast.Call) and \
+                    _dotted(node.func.func, aliases) == "jax.jit":
+                # Direct jax.jit(f)(x) only: the outer call INVOKES the
+                # program.  partial(jax.jit, ...)(f) is the non-decorator
+                # spelling of the @partial idiom — the outer call merely
+                # PRODUCES the wrapper (bound once) and is not a hazard.
+                # Inside a loop the inner jax.jit(...) call already carries
+                # the jit-in-loop finding: one report per expression.
+                out.append(Finding(
+                    "R9", rel, node.lineno, _line_text(lines, node.lineno),
+                    "jax.jit(...)(...) builds and invokes a fresh program "
+                    "in one expression: nothing holds the wrapper, so every "
+                    "call retraces and recompiles — bind the jitted "
+                    "callable once (module level or an lru_cached builder) "
+                    "and reuse it",
+                ))
+            if isinstance(node.func, ast.Name) and node.func.id in static_map:
+                positions, by_name = static_map[node.func.id]
+                for p in sorted(positions):
+                    if p < len(node.args) and isinstance(
+                        node.args[p], _UNHASHABLE_NODES
+                    ):
+                        out.append(Finding(
+                            "R9", rel, node.lineno,
+                            _line_text(lines, node.lineno),
+                            f"unhashable literal in static position {p} of "
+                            f"jitted '{node.func.id}': static jit arguments "
+                            "are hashed per call — this TypeErrors (or "
+                            "retraces forever with a custom hash); pass a "
+                            "frozen dataclass / tuple",
+                        ))
+                for kw in node.keywords:
+                    if kw.arg in by_name and isinstance(
+                        kw.value, _UNHASHABLE_NODES
+                    ):
+                        out.append(Finding(
+                            "R9", rel, node.lineno,
+                            _line_text(lines, node.lineno),
+                            f"unhashable literal for static argument "
+                            f"'{kw.arg}' of jitted '{node.func.id}': static "
+                            "jit arguments are hashed per call — pass a "
+                            "frozen dataclass / tuple",
+                        ))
+    return out
+
+
+# --------------------------------------------------------------------------
 # R3: package-wide call graph
 
 class _Module:
@@ -490,6 +820,119 @@ def _rule_r3(modules: dict[str, _Module]):
 
 
 # --------------------------------------------------------------------------
+# R11: jaxpr-audit registry coverage gate
+
+def _r11_discover(root: pathlib.Path):
+    """Public jitted entry points package-wide: ``[(rel, lineno, name)]``.
+
+    Two shapes count as a compiled surface: a public top-level function
+    decorated ``@jax.jit`` / ``@partial(jax.jit, ...)``, and a public
+    top-level ``make_*`` factory that builds a ``jax.jit`` wrapper (call or
+    inner decorated def).  esac_tpu/lint/ itself is excluded — the auditor
+    is not an audited surface.
+    """
+    out = []
+    for p in sorted((root / "esac_tpu").rglob("*.py")):
+        rel = p.relative_to(root).as_posix()
+        if rel.startswith("esac_tpu/lint/") or \
+                any(part in _SKIP_DIRS for part in p.relative_to(root).parts):
+            continue
+        try:
+            tree = ast.parse(p.read_text(), filename=rel)
+        except (SyntaxError, UnicodeDecodeError):
+            continue  # R0 is reported by the per-file pass
+        aliases = _alias_map(tree)
+
+        def _is_jit_dec(dec) -> bool:
+            for sub in ast.walk(dec):
+                if isinstance(sub, (ast.Name, ast.Attribute)) \
+                        and _dotted(sub, aliases) == "jax.jit":
+                    return True
+            return False
+
+        for node in tree.body:
+            if not isinstance(node, ast.FunctionDef) or \
+                    node.name.startswith("_"):
+                continue
+            jitted = any(_is_jit_dec(d) for d in node.decorator_list)
+            factory = False
+            if node.name.startswith("make_"):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) \
+                            and _dotted(sub.func, aliases) == "jax.jit":
+                        factory = True
+                    elif isinstance(sub, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) \
+                            and sub is not node:
+                        if any(_is_jit_dec(d) for d in sub.decorator_list):
+                            factory = True
+            if jitted or factory:
+                out.append((rel, node.lineno, node.name))
+    return out
+
+
+def _r11_registry_names(registry_source: str) -> tuple[set[str], dict[str, str]]:
+    """-> (identifiers referenced by lint/registry.py, R11_WAIVED map)."""
+    tree = ast.parse(registry_source)
+    names: set[str] = set()
+    waived: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            names.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            names.add(node.attr)
+        elif isinstance(node, ast.ImportFrom):
+            names.update(a.name for a in node.names)
+    for node in tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if not any(isinstance(t, ast.Name) and t.id == "R11_WAIVED"
+                       for t in targets):
+                continue
+            value = node.value
+            if isinstance(value, ast.Dict):
+                for k, v in zip(value.keys, value.values):
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                        try:
+                            waived[k.value] = ast.literal_eval(v)
+                        except ValueError:
+                            waived[k.value] = ""
+    return names, waived
+
+
+def run_registry_coverage(root, files=None) -> list[Finding]:
+    """R11 over the whole package (tree-global: scoped runs still check the
+    full matrix whenever any package file is in scope)."""
+    root = pathlib.Path(root)
+    registry_path = root / "esac_tpu" / "lint" / "registry.py"
+    if not registry_path.exists():
+        return []  # not an audited tree (fixture roots without a registry)
+    if files is not None and not any(
+        f.startswith("esac_tpu/") and f.endswith(".py") for f in files
+    ):
+        return []
+    registered, waived = _r11_registry_names(registry_path.read_text())
+    findings = []
+    for rel, lineno, name in _r11_discover(root):
+        if name in registered or name in waived:
+            continue
+        source = (root / rel).read_text()
+        per_line, per_file = parse_suppressions(source)
+        f = Finding(
+            "R11", rel, lineno,
+            _line_text(source.splitlines(), lineno),
+            f"public jitted entry point '{name}' is neither registered in "
+            "esac_tpu/lint/registry.py nor waived in R11_WAIVED: every "
+            "compiled surface must ride the jaxpr audit + resource ledger "
+            "(add a registry Entry, or a waiver with a reviewed reason)",
+        )
+        if not is_suppressed("R11", lineno, per_line, per_file):
+            findings.append(f)
+    return findings
+
+
+# --------------------------------------------------------------------------
 # driver
 
 def run_python_rules(root, files=None) -> list[Finding]:
@@ -522,6 +965,10 @@ def run_python_rules(root, files=None) -> list[Finding]:
             findings += _rule_r5(rel, tree, aliases, lines)
         if _r6_scope(rel):
             findings += _rule_r6(rel, tree, aliases, lines)
+        if _r8_scope(rel):
+            findings += _rule_r8(rel, tree, aliases, lines)
+        if _r9_scope(rel):
+            findings += _rule_r9(rel, tree, aliases, lines)
         if _r3_scope(rel):
             m = _Module(rel, tree, lines)
             r3_modules[m.dotted] = m

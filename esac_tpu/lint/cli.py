@@ -5,15 +5,22 @@ error.
 
 Modes
 -----
-- default           : layer 1 over the full tree + layer 2 (jaxpr audit)
+- default           : layer 1 over the full tree + layer 2 (jaxpr audit +
+                      resource-ledger diff vs the committed
+                      .jaxpr_ledger.json)
 - ``--changed``     : layer 1 over git-modified/untracked files only; the
-                      jaxpr audit runs only when a traced package file
-                      changed (fast pre-commit mode)
-- ``PATHS…``        : layer 1 over the given files/dirs; the jaxpr audit
-                      runs only when they include package (esac_tpu/) files
-- ``--no-jaxpr``    : skip layer 2 anywhere
+                      jaxpr audit AND the ledger run only when a traced
+                      package file changed (fast pre-commit mode)
+- ``PATHS…``        : layer 1 over the given files/dirs; layer 2 only when
+                      they include package (esac_tpu/) files
+- ``--no-jaxpr``    : skip layer 2 (audit + ledger) anywhere
+- ``--format json`` : machine-readable output — one JSON object per
+                      finding per line on stdout (stable ``id`` field);
+                      notes and the summary go to stderr
 - ``--write-baseline``: regenerate lint_baseline.json from current
                       layer-1 findings (review the diff before committing!)
+- ``--write-ledger``: regenerate .jaxpr_ledger.json from the current
+                      registry traces (review the diff before committing!)
 
 The jaxpr audit itself forces the CPU backend before any device use — the
 lint must never become the second stuck TPU client it lints against
@@ -28,7 +35,7 @@ import subprocess
 import sys
 
 from esac_tpu.lint import run_layer1
-from esac_tpu.lint.findings import RULES
+from esac_tpu.lint.findings import RULES, Finding
 from esac_tpu.lint.suppress import Baseline
 
 BASELINE_NAME = "lint_baseline.json"
@@ -75,11 +82,17 @@ def _expand_paths(root: pathlib.Path, paths: list[str]) -> list[str]:
 def _audit_needed(files: list[str] | None) -> bool:
     # Any package file can shift what the registry entries trace — not least
     # esac_tpu/utils/{precision,num}.py, whose invariants ARE the audit.
+    # The resource ledger rides the same condition (--changed skips it
+    # unless a traced package file changed).
     if files is None:
         return True
     return any(
         f.startswith("esac_tpu/") and f.endswith(".py") for f in files
     )
+
+
+def _note(msg: str) -> None:
+    print(msg, file=sys.stderr)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -92,13 +105,19 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--changed", action="store_true",
                         help="lint only git-modified/untracked files")
     parser.add_argument("--no-jaxpr", action="store_true",
-                        help="skip the layer-2 jaxpr audit")
+                        help="skip the layer-2 jaxpr audit + ledger")
+    parser.add_argument("--format", choices=("text", "json"), default="text",
+                        help="finding output format (json: one object per "
+                             "line, stable ids, notes on stderr)")
     parser.add_argument("--root", default=None,
                         help="repo root (default: auto-detect)")
     parser.add_argument("--baseline", default=None,
                         help=f"baseline path (default: <root>/{BASELINE_NAME})")
     parser.add_argument("--write-baseline", action="store_true",
                         help="regenerate the baseline from current findings")
+    parser.add_argument("--write-ledger", action="store_true",
+                        help="regenerate .jaxpr_ledger.json from the "
+                             "current registry traces")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
@@ -112,6 +131,35 @@ def main(argv: list[str] | None = None) -> int:
         pathlib.Path(args.baseline) if args.baseline else root / BASELINE_NAME
     )
 
+    seen_ids: dict[str, int] = {}
+
+    def emit(f: Finding) -> None:
+        if args.format == "json":
+            ordinal = seen_ids.get(f.id, 0)
+            seen_ids[f.id] = ordinal + 1
+            print(f.to_json(ordinal))
+        else:
+            print(f.format())
+
+    if args.write_ledger:
+        if args.no_jaxpr:
+            _note("graft-lint: --write-ledger needs the jaxpr layer "
+                  "(drop --no-jaxpr)")
+            return 2
+        try:
+            from esac_tpu.lint import ledger as ledger_mod
+            from esac_tpu.lint.jaxpr_audit import trace_entries
+
+            entries, skipped = ledger_mod.build_ledger(trace_entries())
+            ledger_mod.write_ledger(root / ledger_mod.LEDGER_NAME, entries)
+        except Exception as e:
+            _note(f"graft-lint: internal error writing ledger: {e!r}")
+            return 2
+        _note(f"graft-lint: wrote {len(entries)} ledger entries to "
+              f"{root / ledger_mod.LEDGER_NAME}"
+              + (f" (skipped untraceable: {sorted(skipped)})" if skipped else ""))
+        return 0
+
     # Everything up to the verdict is "internal": a crash anywhere here
     # (unreadable path, malformed baseline JSON) must exit 2, never be
     # mistaken for findings (exit 1).
@@ -120,7 +168,7 @@ def main(argv: list[str] | None = None) -> int:
         if args.changed:
             files = _changed_files(root)
             if not files:
-                print("graft-lint: no changed files")
+                _note("graft-lint: no changed files")
                 return 0
         elif args.paths:
             files = _expand_paths(root, args.paths)
@@ -131,14 +179,13 @@ def main(argv: list[str] | None = None) -> int:
             if files is not None:
                 # A scoped run sees only a slice of the tree; writing it out
                 # would silently drop every entry for the unscanned files.
-                print(
+                _note(
                     "graft-lint: --write-baseline requires a full-tree run "
-                    "(drop --changed / PATHS)",
-                    file=sys.stderr,
+                    "(drop --changed / PATHS)"
                 )
                 return 2
             Baseline.from_findings(findings).write(baseline_path)
-            print(
+            _note(
                 f"graft-lint: wrote {len(findings)} entries to {baseline_path}"
             )
             return 0
@@ -146,37 +193,58 @@ def main(argv: list[str] | None = None) -> int:
         baseline = Baseline.load(baseline_path)
         findings, stale = baseline.apply(findings)
     except Exception as e:  # internal error, not a finding
-        print(f"graft-lint: internal error in layer 1: {e!r}", file=sys.stderr)
+        _note(f"graft-lint: internal error in layer 1: {e!r}")
         return 2
     # In scoped runs most baseline entries legitimately match nothing
     # (their files weren't linted) — only report staleness on full runs.
     if files is None:
         for e in stale:
-            print(
+            _note(
                 f"graft-lint: stale baseline entry ({e.rule} {e.path}): "
                 "expired or no longer matches — remove it from "
                 f"{baseline_path.name}"
             )
 
     for f in findings:
-        print(f.format())
+        emit(f)
 
-    audit_failures = []
+    audit_failures: list[Finding] = []
+    ledger_findings: list[Finding] = []
     if not args.no_jaxpr and _audit_needed(files):
         try:
-            from esac_tpu.lint.jaxpr_audit import run_audit
+            from esac_tpu.lint import ledger as ledger_mod
+            from esac_tpu.lint.jaxpr_audit import run_audit, trace_entries
 
-            audit_failures = run_audit()
+            traced = trace_entries()
+            audit_failures = run_audit(traced=traced)
+            current, skipped = ledger_mod.build_ledger(traced)
+            committed = ledger_mod.load_ledger(root / ledger_mod.LEDGER_NAME)
+            if committed is None:
+                ledger_findings = [Finding(
+                    "J4", ledger_mod.LEDGER_NAME, 0, "missing-ledger",
+                    "no committed jaxpr resource ledger; run "
+                    "`python -m esac_tpu.lint --write-ledger`, review the "
+                    "numbers, and commit the file",
+                )]
+            else:
+                ledger_findings, ledger_stale = ledger_mod.diff_ledger(
+                    committed, current, skipped
+                )
+                for note in ledger_stale:
+                    _note(f"graft-lint: {note}")
         except Exception as e:
-            print(f"graft-lint: internal error in jaxpr audit: {e!r}",
-                  file=sys.stderr)
+            _note(f"graft-lint: internal error in jaxpr audit: {e!r}")
             return 2
-        for f in audit_failures:
-            print(f.format())
+        for f in audit_failures + ledger_findings:
+            emit(f)
 
-    n = len(findings) + len(audit_failures)
+    n = len(findings) + len(audit_failures) + len(ledger_findings)
     scope = "changed files" if args.changed else ("paths" if args.paths else "tree")
-    print(f"graft-lint: {n} finding(s) over {scope}"
-          + ("" if args.no_jaxpr or not _audit_needed(files)
-             else " (incl. jaxpr audit)"))
+    summary = (f"graft-lint: {n} finding(s) over {scope}"
+               + ("" if args.no_jaxpr or not _audit_needed(files)
+                  else " (incl. jaxpr audit + ledger)"))
+    if args.format == "json":
+        _note(summary)
+    else:
+        print(summary)
     return 1 if n else 0

@@ -7,6 +7,8 @@ initializing anything jax-adjacent.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
 
 
 @dataclasses.dataclass(frozen=True)
@@ -15,7 +17,7 @@ class Finding:
     line-number-independent identity used for baseline matching, so findings
     survive unrelated edits above them."""
 
-    rule: str      # "R1".."R7" or "J1".."J3" (jaxpr auditor)
+    rule: str      # "R1".."R11" or "J1".."J4" (jaxpr auditor / ledger)
     path: str      # repo-relative, forward slashes
     line: int      # 1-based; 0 for whole-file findings
     text: str      # stripped source line ("" for whole-file findings)
@@ -23,6 +25,35 @@ class Finding:
 
     def format(self) -> str:
         return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    @property
+    def id(self) -> str:
+        """Stable finding id for CI/driver consumption (``--format json``):
+        keyed on the same line-number-independent identity the baseline
+        uses, so the id survives unrelated edits above the finding."""
+        digest = hashlib.sha1(
+            f"{self.rule}|{self.path}|{self.text}".encode()
+        ).hexdigest()[:10]
+        return f"{self.rule}-{digest}"
+
+    def to_json(self, ordinal: int = 0) -> str:
+        """One-line JSON object (the ``--format json`` record).
+
+        ``ordinal`` disambiguates findings sharing the same (rule, path,
+        text) identity within one run — textually identical lines in
+        different methods would otherwise collide; the CLI numbers them in
+        report order (stable under edits elsewhere in the file), so a
+        driver keying on ``id`` never conflates two real findings.
+        """
+        fid = self.id if ordinal == 0 else f"{self.id}-{ordinal + 1}"
+        return json.dumps({
+            "id": fid,
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "text": self.text,
+            "message": self.message,
+        }, sort_keys=True)
 
 
 # rule id -> (summary, rationale pointer).  LINT.md carries the full prose.
@@ -72,6 +103,38 @@ RULES = {
         "chip-touching scripts the way bench.py does (detached child, "
         "poll, never kill)",
     ),
+    "R8": (
+        "buffer reused after riding a donated position (donation safety)",
+        "registry/serving.py donation policy: a donated buffer is "
+        "invalidated at the call — reusing it (across loop iterations, "
+        "after the call, or donating a cached/registry-held tree) crashes "
+        "on accelerators; restage per-dispatch data, never donate cached "
+        "params",
+    ),
+    "R9": (
+        "retrace hazard: jit built in a loop / invoked inline / unhashable "
+        "static argument",
+        "CLAUDE.md code conventions: compile-exactly-once per bucket is "
+        "load-bearing (tests/test_registry.py, tests/test_serve_routed.py) "
+        "— a jit wrapper built per iteration or invoked as "
+        "jax.jit(f)(x) recompiles every pass, and unhashable static "
+        "arguments break jit hashing outright",
+    ),
+    "R10": (
+        "lock-guarded mutable state touched outside the instance lock",
+        "serve/dispatcher.py + registry/cache.py concurrency invariant: "
+        "rings, pending queues, LRU order and per-lane stats are shared "
+        "across the worker and submitter threads — every access must hold "
+        "the instance lock the class already uses for the same attribute",
+    ),
+    "R11": (
+        "public jitted entry point missing from the jaxpr-audit registry",
+        "LINT.md layer 2: every compiled surface must be registered in "
+        "esac_tpu/lint/registry.py (traced + audited + ledgered) or "
+        "explicitly waived in R11_WAIVED with a reviewed reason — the "
+        "coverage gate that keeps the entry-point matrix inside the audit "
+        "(ROADMAP item 5 precondition)",
+    ),
     # Layer-2 (jaxpr auditor) finding ids, reported with path = the
     # registry entry name:
     "J1": (
@@ -89,5 +152,13 @@ RULES = {
         "precision-pinned call graph",
         "CLAUDE.md code conventions: bf16-default MXU corrupts rotation "
         "math; geometry-core contractions go through hmm/heinsum",
+    ),
+    "J4": (
+        "jaxpr resource ledger regression vs the committed "
+        ".jaxpr_ledger.json",
+        "LINT.md ledger workflow: per-entry flops / peak intermediate "
+        "bytes / dot-precision census are committed numbers — growth "
+        "beyond tolerance, a dropped HIGHEST pin, or an unledgered entry "
+        "fails; regenerate with --write-ledger and review the diff",
     ),
 }

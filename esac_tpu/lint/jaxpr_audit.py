@@ -135,14 +135,30 @@ def audit_jaxpr(name: str, closed_jaxpr, pinned: bool) -> list[Finding]:
     return findings
 
 
-def run_audit(entries=None) -> list[Finding]:
-    """Trace every registry entry and return all findings."""
+def trace_entries(entries=None) -> list:
+    """Trace every registry entry ONCE: ``[(Entry, ClosedJaxpr | None)]``.
+
+    The shared tracing pass behind both the J1-J3 audit and the resource
+    ledger (:mod:`esac_tpu.lint.ledger`): tracing dominates layer-2 cost
+    (~20s full registry), so callers needing both must not trace twice.
+    ``None`` marks an entry not traceable in this process (e.g. no 8-device
+    mesh) — consumers skip it rather than failing.
+    """
     _force_cpu()
     from esac_tpu.lint.registry import ENTRIES
 
+    return [
+        (entry, entry.build())
+        for entry in (entries if entries is not None else ENTRIES)
+    ]
+
+
+def run_audit(entries=None, traced=None) -> list[Finding]:
+    """All J1-J3 findings over the registry (or a pre-traced list)."""
+    if traced is None:
+        traced = trace_entries(entries)
     findings: list[Finding] = []
-    for entry in entries if entries is not None else ENTRIES:
-        closed = entry.build()
+    for entry, closed in traced:
         if closed is None:
             continue  # entry not traceable in this process (e.g. no mesh)
         findings += audit_jaxpr(entry.name, closed, entry.pinned)

@@ -1,0 +1,391 @@
+"""Layer 2b: the jaxpr resource ledger (graft-audit v2).
+
+The J1-J3 audit answers "is this jaxpr *allowed*?"; the ledger answers
+"what does this jaxpr *cost*?" — and pins the answer.  For every registered
+entry point it walks the traced jaxpr (the same shared tracing pass as the
+audit, :func:`esac_tpu.lint.jaxpr_audit.trace_entries`) and emits:
+
+- **flops** — an analytic estimate with scan trip counts multiplied in:
+  ``2*out*contract`` for ``dot_general``, ``2*out*kernel/out_features``
+  for convolutions, one flop per output element for everything else.
+- **peak_intermediate_bytes** — a linear-scan liveness analysis over
+  eqn-produced values (inputs and consts excluded), recursing into
+  pjit/scan/cond/shard_map sub-jaxprs.  This is the materialization the
+  *jaxpr implies* — an upper bound XLA fusion then improves on — which is
+  exactly the number DESIGN.md §9's fusion argument needs: the scoring
+  path's per-hypothesis errmap shows up here as a committed byte count
+  instead of an ~80%-of-pipeline prose claim.
+- **dot census** — ``dot_general`` counts keyed by ``precision:out_dtype``
+  so a dropped HIGHEST pin is a *diff*, not a hope (J3 only covers
+  ``pinned=True`` entries; the census also guards the HIGHEST geometry
+  core inside unpinned CNN-bearing programs).
+- **top_intermediates** — the largest eqn-produced tensors with their
+  primitives, so "what materializes" is readable per entry.
+
+All numbers are computed at the registry's fixed tiny trace shapes, so the
+committed ``.jaxpr_ledger.json`` is deterministic on this container: the
+tier-1 gate asserts the recomputed ledger matches it exactly, and
+:func:`diff_ledger` turns *regressions* (bytes/flops growth beyond
+tolerance, a HIGHEST pin dropped, an unregistered new entry) into J4
+findings (exit 1) while mere drift is reported stale (regenerate with
+``python -m esac_tpu.lint --write-ledger`` and review the diff, exactly
+like the findings baseline).
+
+Everything imports jax lazily; the tracing pass forces the CPU backend
+first (CLAUDE.md environment hazards).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import pathlib
+
+from esac_tpu.lint.findings import Finding
+
+LEDGER_NAME = ".jaxpr_ledger.json"
+
+# Growth beyond these factors is a J4 regression; anything smaller is
+# reported as a stale (regenerate-and-review) entry.  "Silently doubling an
+# entry's materialization" must fail with margin.
+BYTES_TOL = 1.25
+FLOPS_TOL = 1.25
+
+_TOP_N = 5
+
+# Entries whose trace is known to materialize the per-hypothesis
+# reprojection-error map the argmax immediately consumes (the DESIGN.md §9
+# fusion target).  Dims are the registry builders' trace shapes; the ledger
+# records the implied errmap bytes and whether a tensor of exactly that
+# footprint is present in the trace.
+_ERRMAP_DIMS = {
+    "esac_infer_frames": {"B": 2, "M": 2, "n_hyps": 8, "n_cells": 16},
+    "scoring_errmap_grad": {"n_hyps": 4, "n_cells": 16},
+}
+
+
+# --------------------------------------------------------------------------
+# jaxpr walking
+
+def _is_jaxpr(obj) -> bool:
+    return hasattr(obj, "eqns") and hasattr(obj, "invars")
+
+
+def _as_jaxpr(obj):
+    """ClosedJaxpr | Jaxpr -> Jaxpr (or None)."""
+    if _is_jaxpr(obj):
+        return obj
+    inner = getattr(obj, "jaxpr", None)
+    return inner if _is_jaxpr(inner) else None
+
+
+def _sub_jaxprs(eqn):
+    """-> [(sub_jaxpr, trip_multiplier)] for one equation."""
+    name = eqn.primitive.name
+    params = eqn.params
+    if name == "scan":
+        j = _as_jaxpr(params.get("jaxpr"))
+        return [(j, int(params.get("length", 1)))] if j is not None else []
+    if name == "cond":
+        # One branch executes; cost is the max, so return branches with a
+        # marker multiplier handled by the caller.
+        return [(_as_jaxpr(b), -1) for b in params.get("branches", ())
+                if _as_jaxpr(b) is not None]
+    out = []
+    for v in params.values():
+        vals = v if isinstance(v, (list, tuple)) else (v,)
+        for item in vals:
+            j = _as_jaxpr(item)
+            if j is not None:
+                out.append((j, 1))
+    return out
+
+
+def _nelems(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    if not shape:
+        return 1
+    return int(math.prod(int(d) for d in shape))
+
+
+def _nbytes(aval) -> int:
+    dtype = getattr(aval, "dtype", None)
+    itemsize = getattr(dtype, "itemsize", 0)
+    return _nelems(aval) * int(itemsize)
+
+
+def _eqn_self_flops(eqn) -> int:
+    """Flops of one equation, sub-jaxprs excluded."""
+    name = eqn.primitive.name
+    out_elems = sum(_nelems(v.aval) for v in eqn.outvars)
+    if name == "dot_general":
+        (lc, _), _ = eqn.params["dimension_numbers"]
+        lhs_shape = eqn.invars[0].aval.shape
+        contract = 1
+        for d in lc:
+            contract *= int(lhs_shape[d])
+        return 2 * _nelems(eqn.outvars[0].aval) * contract
+    if name == "conv_general_dilated":
+        dn = eqn.params["dimension_numbers"]
+        rhs = eqn.invars[1].aval.shape
+        out_feat = int(rhs[dn.rhs_spec[0]])
+        per_out = max(1, _nelems(eqn.invars[1].aval) // max(1, out_feat))
+        return 2 * _nelems(eqn.outvars[0].aval) * per_out
+    return out_elems  # elementwise proxy: one flop per output element
+
+
+def _precision_label(precision) -> str:
+    from esac_tpu.lint.jaxpr_audit import _precision_is_highest
+
+    if _precision_is_highest(precision):
+        return "HIGHEST"
+    if precision is None:
+        return "DEFAULT"
+    return str(precision)
+
+
+def _walk(jaxpr, census: dict, tops: list, mult: int = 1) -> tuple[int, int]:
+    """-> (flops, peak_intermediate_bytes) of one Jaxpr, recursive.
+
+    ``census``/``tops`` accumulate across the whole walk (census counts are
+    *static* — one per compiled eqn, not per scan trip; flops multiply the
+    trip count in).  Peak bytes is a liveness scan over eqn-produced values
+    only — jaxpr invars and consts are the caller's storage, not this
+    program's intermediates.
+    """
+    eqns = list(jaxpr.eqns)
+
+    # Last-use position of every eqn-produced var (jaxpr outvars live to
+    # the end).
+    import jax.core as jc
+
+    def _is_var(v) -> bool:
+        return isinstance(v, jc.Var) and not isinstance(v, jc.DropVar)
+
+    produced: set = set()
+    for eqn in eqns:
+        produced.update(v for v in eqn.outvars if _is_var(v))
+    last_use: dict = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.invars:
+            if _is_var(v) and v in produced:
+                last_use[v] = i
+    for v in jaxpr.outvars:
+        if _is_var(v) and v in produced:
+            last_use[v] = len(eqns)
+
+    flops = 0
+    live_bytes = 0
+    peak = 0
+    alive: set = set()
+    for i, eqn in enumerate(eqns):
+        prim = eqn.primitive.name
+        if prim == "dot_general":
+            key = (f"{_precision_label(eqn.params.get('precision'))}:"
+                   f"{eqn.outvars[0].aval.dtype}")
+            census[key] = census.get(key, 0) + 1
+
+        subs = _sub_jaxprs(eqn)
+        if prim == "cond":
+            branch_stats = [_walk(j, census, tops, mult) for j, _ in subs]
+            sub_flops = max((f for f, _ in branch_stats), default=0)
+            sub_peak = max((p for _, p in branch_stats), default=0)
+        else:
+            sub_flops = 0
+            sub_peak = 0
+            for j, trip in subs:
+                f, p = _walk(j, census, tops, mult * trip)
+                sub_flops += f
+                sub_peak = max(sub_peak, p)
+        flops += mult * _eqn_self_flops(eqn) + sub_flops
+
+        out_bytes = 0
+        for v in eqn.outvars:
+            if not _is_var(v):
+                continue
+            b = _nbytes(v.aval)
+            out_bytes += b
+            shape = tuple(int(d) for d in getattr(v.aval, "shape", ()))
+            tops.append((b, prim, shape, str(getattr(v.aval, "dtype", "?"))))
+        peak = max(peak, live_bytes + out_bytes + sub_peak)
+        for v in eqn.outvars:
+            if _is_var(v) and last_use.get(v, -1) > i:
+                alive.add(v)
+                live_bytes += _nbytes(v.aval)
+        retired = set()
+        for v in eqn.invars:
+            if not _is_var(v) or id(v) in retired:
+                continue
+            retired.add(id(v))
+            if v in alive and last_use.get(v) == i:
+                alive.discard(v)
+                live_bytes -= _nbytes(v.aval)
+    return flops, peak
+
+
+def entry_stats(closed_jaxpr) -> dict:
+    """Resource stats for one traced entry point."""
+    jaxpr = _as_jaxpr(closed_jaxpr)
+    census: dict = {}
+    tops: list = []
+    flops, peak = _walk(jaxpr, census, tops)
+    tops.sort(key=lambda t: (-t[0], t[1], t[2], t[3]))
+    seen = set()
+    top_intermediates = []
+    for b, prim, shape, dtype in tops:
+        key = (prim, shape, dtype)
+        if key in seen:
+            continue
+        seen.add(key)
+        top_intermediates.append(
+            {"primitive": prim, "shape": list(shape), "dtype": dtype,
+             "bytes": b}
+        )
+        if len(top_intermediates) >= _TOP_N:
+            break
+    return {
+        "flops": int(flops),
+        "peak_intermediate_bytes": int(peak),
+        "dot_general_count": sum(census.values()),
+        "dot_census": dict(sorted(census.items())),
+        "top_intermediates": top_intermediates,
+        "_all_tensors": tops,  # stripped before serialization
+    }
+
+
+def _errmap_record(name: str, stats: dict) -> dict | None:
+    dims = _ERRMAP_DIMS.get(name)
+    if dims is None:
+        return None
+    elems = math.prod(dims.values())
+    nbytes = 4 * elems  # f32 reprojection errors
+    present = any(
+        b == nbytes and dtype == "float32"
+        for b, _, _, dtype in stats["_all_tensors"]
+    )
+    return {
+        "bytes_at_trace_shapes": nbytes,
+        "present_in_trace": present,
+        "formula": "prod(trace_dims) * 4 bytes (f32 error per "
+                   "(hypothesis, cell)); scales linearly to serve shapes",
+        "trace_dims": dims,
+    }
+
+
+# --------------------------------------------------------------------------
+# ledger build / io / diff
+
+def build_ledger(traced) -> tuple[dict, set]:
+    """``trace_entries()`` output -> (name -> stats dict, skipped names)."""
+    entries: dict = {}
+    skipped: set = set()
+    for entry, closed in traced:
+        if closed is None:
+            skipped.add(entry.name)
+            continue
+        stats = entry_stats(closed)
+        errmap = _errmap_record(entry.name, stats)
+        del stats["_all_tensors"]
+        stats = {"pinned": entry.pinned, **stats}
+        if errmap is not None:
+            stats["errmap"] = errmap
+        entries[entry.name] = stats
+    return entries, skipped
+
+
+def write_ledger(path: pathlib.Path, entries: dict) -> None:
+    data = {
+        "comment": "graft-audit v2 jaxpr resource ledger; see LINT.md. "
+                   "Per registered entry point at fixed tiny trace shapes: "
+                   "analytic flops, peak intermediate bytes (liveness over "
+                   "the jaxpr — the pre-fusion materialization bound), and "
+                   "the dot_general precision census.  Regenerate with "
+                   "`python -m esac_tpu.lint --write-ledger` and review "
+                   "the diff; regressions beyond tolerance fail tier-1.",
+        "entries": {k: entries[k] for k in sorted(entries)},
+    }
+    path.write_text(json.dumps(data, indent=2) + "\n")
+
+
+def load_ledger(path: pathlib.Path) -> dict | None:
+    if not path.exists():
+        return None
+    return json.loads(path.read_text()).get("entries", {})
+
+
+def _census_counts(stats: dict) -> tuple[int, int]:
+    highest = 0
+    other = 0
+    for key, n in stats.get("dot_census", {}).items():
+        if key.startswith("HIGHEST:"):
+            highest += n
+        else:
+            other += n
+    return highest, other
+
+
+def diff_ledger(
+    committed: dict, current: dict, skipped: set = frozenset()
+) -> tuple[list[Finding], list[str]]:
+    """-> (J4 regression findings, stale-entry notes).
+
+    Regressions fail the lint: an entry missing from the committed ledger,
+    peak bytes / flops growth beyond tolerance, or a precision-census
+    regression (HIGHEST dots lost while non-HIGHEST appear).  Everything
+    else that mismatches — improvements, drift inside tolerance, entries no
+    longer in the registry — is stale: the committed file must be
+    regenerated (and the diff reviewed), but the tree is not worse.
+    """
+    findings: list[Finding] = []
+    stale: list[str] = []
+
+    def add(name: str, text: str, message: str) -> None:
+        findings.append(Finding("J4", name, 0, text, message))
+
+    for name, cur in current.items():
+        old = committed.get(name)
+        if old is None:
+            add(name, "missing-entry",
+                "entry has no committed ledger record; run "
+                "`python -m esac_tpu.lint --write-ledger`, review the "
+                "numbers, and commit the diff")
+            continue
+        drift = False
+        for field, tol in (("peak_intermediate_bytes", BYTES_TOL),
+                           ("flops", FLOPS_TOL)):
+            was, now = old.get(field, 0), cur.get(field, 0)
+            if now > was * tol:
+                add(name, f"{field}:{was}->{now}",
+                    f"{field} grew {was} -> {now} "
+                    f"(> {tol}x committed): this entry now materializes/"
+                    "computes more than the committed budget — if "
+                    "intentional, regenerate the ledger and review")
+            elif now != was:
+                drift = True
+        old_hi, old_other = _census_counts(old)
+        new_hi, new_other = _census_counts(cur)
+        if new_hi < old_hi and new_other > old_other:
+            add(name,
+                f"census:HIGHEST {old_hi}->{new_hi}, "
+                f"other {old_other}->{new_other}",
+                "precision census regression: HIGHEST dot_generals were "
+                "lost while unpinned ones appeared — a HIGHEST pin was "
+                "dropped (route contractions through "
+                "utils.precision.hmm/heinsum)")
+        elif (new_hi, new_other) != (old_hi, old_other):
+            drift = True
+        if cur.get("dot_census") != old.get("dot_census"):
+            drift = True
+        if drift:
+            stale.append(
+                f"ledger entry '{name}' drifted from the committed record "
+                "(within tolerance) — regenerate with --write-ledger and "
+                "review the diff"
+            )
+    for name in committed:
+        if name not in current and name not in skipped:
+            stale.append(
+                f"ledger entry '{name}' no longer matches any registry "
+                "entry — regenerate with --write-ledger"
+            )
+    return findings, stale

@@ -45,6 +45,12 @@ def _score_hypotheses(key, rvecs, tvecs, coords, pixels, f, c, cfg):
     and inference both honor it.  cfg.use_pallas_scoring=True is the
     back-compat override forcing "pallas" (custom_vjp with an analytic XLA
     backward mirroring the kernel math).
+
+    HBM note: the "errmap" path materializes the full (n_hyps, n_cells)
+    reprojection-error map that the selection argmax immediately consumes —
+    B*M*n_hyps*n_cells*4 bytes per dispatch, the committed number in
+    .jaxpr_ledger.json (entries esac_infer_frames / scoring_errmap_grad,
+    graft-audit v2) and the DESIGN.md §9 / ROADMAP item 3 fusion target.
     """
     coords_s, pixels_s, scale = subsample_cells(key, coords, pixels, cfg.score_cells)
     impl = "pallas" if cfg.use_pallas_scoring else cfg.scoring_impl

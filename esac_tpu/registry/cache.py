@@ -105,7 +105,7 @@ class DeviceWeightCache:
     def _evict_to_budget(self) -> None:
         if self._budget is None:
             return
-        while len(self._trees) > 1 and self.bytes_in_use > self._budget:
+        while len(self._trees) > 1 and self._bytes_in_use() > self._budget:
             victim, _ = self._trees.popitem(last=False)
             del self._nbytes[victim]
             self.evictions.append(victim)
@@ -113,9 +113,15 @@ class DeviceWeightCache:
 
     # ---- introspection / management ----
 
+    def _bytes_in_use(self) -> int:
+        """Byte total, lock held by the caller (the public property takes
+        the lock itself — graft-lint R10 lock discipline)."""
+        return sum(self._nbytes.values())
+
     @property
     def bytes_in_use(self) -> int:
-        return sum(self._nbytes.values())
+        with self._lock:
+            return self._bytes_in_use()
 
     def keys(self) -> list[Any]:
         """Resident keys, least-recently-used first (the eviction order)."""
@@ -127,7 +133,8 @@ class DeviceWeightCache:
             return key in self._trees
 
     def __len__(self) -> int:
-        return len(self._trees)
+        with self._lock:
+            return len(self._trees)
 
     def evict(self, key) -> bool:
         """Drop one entry (e.g. a rolled-back version); True if resident."""
@@ -152,6 +159,6 @@ class DeviceWeightCache:
                 "misses": self.misses,
                 "evictions": self.evictions_total,
                 "resident": len(self._trees),
-                "bytes_in_use": self.bytes_in_use,
+                "bytes_in_use": self._bytes_in_use(),
                 "budget_bytes": self._budget,
             }

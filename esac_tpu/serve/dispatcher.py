@@ -317,6 +317,14 @@ class MicroBatchDispatcher:
             return {q: float("nan") for q in qs}
         return {q: lat[min(len(lat) - 1, round(q * (len(lat) - 1)))] for q in qs}
 
+    def dispatch_totals(self) -> dict:
+        """Per-(scene, route_k) lifetime dispatch counts, snapshotted under
+        the lock — the accessor concurrent monitors must use (iterating
+        ``dispatch_counts`` raw while the worker appends is a torn read;
+        graft-lint R10 discipline applies to callers too)."""
+        with self._lock:
+            return dict(self.dispatch_counts)
+
     def reset_stats(self):
         with self._lock:
             self.latencies_s.clear()

@@ -59,11 +59,21 @@ def pytest_sessionfinish(session, exitstatus):
             or session.testscollected < 100 or int(exitstatus) > 1):
         return
     try:
-        TIER1_WALL_FILE.write_text(json.dumps({
+        # Merge-write: other recorders (tests/test_lint.py stores the lint
+        # gate's own wall clock under "lint_wall_s") share this file —
+        # preserve their keys instead of clobbering the record.
+        record = {}
+        if TIER1_WALL_FILE.exists():
+            try:
+                record = json.loads(TIER1_WALL_FILE.read_text())
+            except (OSError, ValueError):
+                record = {}
+        record.update({
             "elapsed_s": round(time.time() - t0, 1),
             "t": time.time(),
             "markexpr": markexpr,
             "n_collected": session.testscollected,
-        }))
+        })
+        TIER1_WALL_FILE.write_text(json.dumps(record))
     except OSError:
         pass

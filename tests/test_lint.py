@@ -1,9 +1,13 @@
-"""graft-lint tests: golden trigger + near-miss fixtures per rule R1-R7,
-suppression/baseline machinery, the jaxpr auditor, CLI exit codes, and the
-tier-1 gate that the committed tree is clean modulo lint_baseline.json.
+"""graft-lint tests: golden trigger + near-miss fixtures per rule R1-R11,
+suppression/baseline machinery, the jaxpr auditor + resource ledger
+(graft-audit v2), CLI exit codes / JSON format, and the tier-1 gates that
+the committed tree is clean modulo lint_baseline.json and that the
+committed .jaxpr_ledger.json matches the tree exactly.
 
 Fixture sources are written into tmp_path trees that mimic the repo layout
-(rule scopes are path-based), never into the repo itself.
+(rule scopes are path-based), never into the repo itself.  The registry is
+traced ONCE per test module (``traced_registry``) and shared by the audit,
+ledger and wall-clock tests — tracing dominates layer-2 cost.
 """
 
 from __future__ import annotations
@@ -12,6 +16,7 @@ import datetime
 import json
 import pathlib
 import textwrap
+import time
 
 import pytest
 
@@ -20,6 +25,16 @@ from esac_tpu.lint.cli import main as lint_main
 from esac_tpu.lint.suppress import Baseline, BaselineEntry, parse_suppressions
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def traced_registry():
+    """(traced entries, trace seconds): the shared layer-2 tracing pass."""
+    from esac_tpu.lint.jaxpr_audit import trace_entries
+
+    t0 = time.perf_counter()
+    traced = trace_entries()
+    return traced, time.perf_counter() - t0
 
 
 def _write(root: pathlib.Path, rel: str, text: str) -> str:
@@ -288,6 +303,426 @@ def test_r6_esac_tpu_import_counts_as_jax_adjacent(tmp_path):
 
 
 # --------------------------------------------------------------------------
+# R8: donation safety
+
+def test_r8_donated_batch_reused_across_loop_is_the_pr4_bug(tmp_path):
+    # Faithful reconstruction of the PR-4 bench bug: a donating bucket fn
+    # driven in a timing loop with ONE staged batch tree.  On accelerators
+    # the first dispatch invalidates the tree; every later iteration reads
+    # freed buffers.
+    _write(tmp_path, "bench_fixture.py", """\
+        import jax
+
+        def make_bucket_fn(cfg):
+            def run(params, batch):
+                return batch
+            donate = (1,) if cfg else ()
+            return jax.jit(run, donate_argnums=donate)
+
+        def timed(params, stage):
+            fn = make_bucket_fn(True)
+            batch = stage()
+            for _ in range(10):
+                out = fn(params, batch)
+            return out
+        """)
+    findings = run_layer1(tmp_path)
+    assert _rules(findings) == ["R8"]
+    assert "loop" in findings[0].message or "iteration" in findings[0].message
+
+
+def test_r8_fresh_tree_per_call_is_the_sanctioned_pattern(tmp_path):
+    # The shipped bench.py fix: restage a fresh device tree every call.
+    _write(tmp_path, "bench_ok.py", """\
+        import jax
+
+        def timed(params, stage):
+            fn = jax.jit(lambda p, b: b, donate_argnums=(1,))
+            for _ in range(10):
+                out = fn(params, stage())
+            return out
+
+        def restaged_inside(params, stage):
+            fn = jax.jit(lambda p, b: b, donate_argnums=(1,))
+            for _ in range(10):
+                batch = stage()            # restaged within the loop body
+                out = fn(params, batch)
+            return out
+
+        def undonated(params, batch):
+            fn = jax.jit(lambda p, b: b)   # no donation: reuse is fine
+            for _ in range(10):
+                out = fn(params, batch)
+            return out
+        """)
+    assert run_layer1(tmp_path) == []
+
+
+def test_r8_use_after_donation(tmp_path):
+    _write(tmp_path, "tools/use_after.py", """\
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+        def once(params, batch):
+            fn = jax.jit(lambda p, b: b, donate_argnums=(1,))
+            out = fn(params, batch)
+            return out, batch["image"]     # read after donation
+        """)
+    findings = run_layer1(tmp_path)
+    assert _rules(findings) == ["R8"]
+    assert "after" in findings[0].message
+
+
+def test_r8_donating_a_cached_registry_tree(tmp_path):
+    _write(tmp_path, "esac_tpu/serve_glue.py", """\
+        import jax
+
+        def dispatch(registry, entry, batch):
+            fn = jax.jit(lambda p, b: b, donate_argnums=(0,))
+            params = registry.cache.get(entry)
+            return fn(params, batch)
+        """)
+    findings = run_layer1(tmp_path)
+    assert _rules(findings) == ["R8"]
+    assert "cache" in findings[0].message
+
+
+def test_r8_multiline_call_and_restage_are_near_misses(tmp_path):
+    # Black-style formatting puts the donated argument's own load BELOW the
+    # call's opening line — that is not a reuse; and a tree explicitly
+    # restaged after the donating call is a NEW buffer, so a later load of
+    # the rebound name is fine (reaching-def cutoff).
+    _write(tmp_path, "bench_fmt.py", """\
+        import jax
+
+        def multiline(params, batch):
+            fn = jax.jit(lambda p, b: b, donate_argnums=(1,))
+            out = fn(
+                params,
+                batch,
+            )
+            return out
+
+        def restaged(params, stage):
+            fn = jax.jit(lambda p, b: b, donate_argnums=(1,))
+            batch = stage()
+            out = fn(params, batch)
+            batch = stage()              # fresh buffers from here on
+            return out, batch["image"]
+        """)
+    assert run_layer1(tmp_path) == []
+
+
+def test_r8_tuple_unpack_and_for_target_restaging_are_near_misses(tmp_path):
+    # `batch, labels = next(it)` and `for batch in it:` both rebind the
+    # donated name every iteration — restaging, not reuse.
+    _write(tmp_path, "bench_unpack.py", """\
+        import jax
+
+        def unpacked(params, it):
+            fn = jax.jit(lambda p, b: b, donate_argnums=(1,))
+            for i in it:
+                batch, labels = i
+                out = fn(params, batch)
+            return out
+
+        def for_target(params, batches):
+            fn = jax.jit(lambda p, b: b, donate_argnums=(1,))
+            for batch in batches:
+                out = fn(params, batch)
+            return out
+        """)
+    assert run_layer1(tmp_path) == []
+
+
+def test_r8_tests_are_out_of_scope(tmp_path):
+    _write(tmp_path, "tests/test_adversarial.py", """\
+        import jax
+
+        def test_donation_crash():
+            fn = jax.jit(lambda b: b, donate_argnums=(0,))
+            batch = {"x": 1}
+            for _ in range(2):
+                fn(batch)                  # deliberate, under test
+        """)
+    assert run_layer1(tmp_path) == []
+
+
+# --------------------------------------------------------------------------
+# R9: retrace safety
+
+def test_r9_jit_in_loop_and_immediate_invocation(tmp_path):
+    _write(tmp_path, "esac_tpu/retrace.py", """\
+        import jax
+
+        def per_item(xs):
+            for x in xs:
+                f = jax.jit(lambda v: v + 1)     # fresh wrapper per pass
+                x = f(x)
+            return x
+
+        def inline(x):
+            return jax.jit(lambda v: v * 2)(x)   # build + call + discard
+        """)
+    findings = run_layer1(tmp_path)
+    assert _rules(findings) == ["R9", "R9"]
+    assert "loop" in findings[0].message
+    assert "fresh program" in findings[1].message
+
+
+def test_r9_jit_inline_inside_loop_reports_once(tmp_path):
+    # jax.jit(f)(x) inside a loop is ONE hazard: the inner maker call
+    # carries the jit-in-loop finding, the outer invoke must not add a
+    # second report for the same expression.
+    _write(tmp_path, "esac_tpu/retrace_loop.py", """\
+        import jax
+
+        def per_item(xs):
+            for x in xs:
+                x = jax.jit(lambda v: v + 1)(x)
+            return x
+        """)
+    findings = run_layer1(tmp_path)
+    assert _rules(findings) == ["R9"]
+    assert "loop" in findings[0].message
+
+
+def test_r9_bound_wrappers_are_near_misses(tmp_path):
+    _write(tmp_path, "esac_tpu/retrace_ok.py", """\
+        from functools import partial
+
+        import jax
+
+        def _impl(x, cfg):
+            return x
+
+        # The non-decorator spelling of @partial(jax.jit, ...): the outer
+        # call PRODUCES the wrapper (bound once) — not an invocation.
+        run = partial(jax.jit, static_argnames=("cfg",))(_impl)
+
+        def make_server():
+            return jax.jit(lambda v: v)          # factory: caller binds it
+
+        def profile(x):
+            f = jax.jit(lambda v: v)             # bound once, reused below
+            for _ in range(3):
+                x = f(x)
+            return x
+        """)
+    assert run_layer1(tmp_path) == []
+
+
+def test_r9_unhashable_literal_in_static_position(tmp_path):
+    _write(tmp_path, "esac_tpu/static_args.py", """\
+        from functools import partial
+
+        import jax
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def run(x, cfg):
+            return x
+
+        def bad_positional(x):
+            return run(x, {"n": 1})
+
+        def bad_keyword(x):
+            return run(x, cfg=[1, 2])
+
+        def good(x, frozen_cfg):
+            return run(x, frozen_cfg)            # hashable static: fine
+        """)
+    findings = run_layer1(tmp_path)
+    assert _rules(findings) == ["R9", "R9"]
+    assert all("static" in f.message for f in findings)
+
+
+def test_r9_scope_is_the_package(tmp_path):
+    # Root scripts are one-shot trainers: a single extra trace is not a
+    # serving regression, so R9 stays inside esac_tpu/.
+    _write(tmp_path, "train_fixture.py", """\
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        X = jax.jit(lambda v: v)(1.0)
+        """)
+    assert _rules(run_layer1(tmp_path)) == []
+
+
+# --------------------------------------------------------------------------
+# R10: serve-layer lock discipline
+
+def test_r10_unlocked_touch_of_lock_guarded_state(tmp_path):
+    _write(tmp_path, "esac_tpu/serve/racy.py", """\
+        import threading
+
+        class RingStats:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._work = threading.Condition(self._lock)
+                self.ring = []
+                self.total = 0
+
+            def record(self, x):
+                with self._work:          # Condition aliases the lock
+                    self.ring.append(x)
+                    self.total += 1
+
+            def snapshot(self):
+                return list(self.ring)    # unlocked read of guarded state
+
+            def drop(self):
+                self.ring.clear()         # unlocked mutation
+        """)
+    findings = run_layer1(tmp_path)
+    assert _rules(findings) == ["R10", "R10"]
+    assert {("snapshot" in f.message or "drop" in f.message)
+            for f in findings} == {True}
+    assert all("ring" in f.message for f in findings)
+    # total is only ever touched under the lock: not flagged.
+    assert not any("total" in f.message for f in findings)
+
+
+def test_r10_near_misses(tmp_path):
+    _write(tmp_path, "esac_tpu/registry/clean.py", """\
+        import threading
+
+        class CleanCache:
+            def __init__(self, clock):
+                self._lock = threading.Lock()
+                self._clock = clock       # immutable post-init
+                self.ring = []
+
+            def record(self, x):
+                with self._lock:
+                    self.ring.append((self._clock(), x))
+
+            def t(self):
+                return self._clock()      # unlocked read of immutable state
+
+            def snapshot(self):
+                with self._lock:
+                    return list(self.ring)
+
+            def _flush_locked(self):
+                self.ring.clear()         # helper: every call site locked
+
+            def reset(self):
+                with self._lock:
+                    self._flush_locked()
+
+        class NoLock:
+            def __init__(self):
+                self.ring = []
+
+            def record(self, x):
+                self.ring.append(x)       # no lock convention: out of scope
+        """)
+    # The same racy shape OUTSIDE serve/registry is out of R10's scope.
+    _write(tmp_path, "esac_tpu/models/racy.py", """\
+        import threading
+
+        class Elsewhere:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.ring = []
+
+            def locked(self):
+                with self._lock:
+                    self.ring.append(1)
+
+            def unlocked(self):
+                self.ring.clear()
+        """)
+    assert run_layer1(tmp_path) == []
+
+
+# --------------------------------------------------------------------------
+# R11: jaxpr-audit registry coverage gate
+
+def _write_r11_tree(tmp_path):
+    _write(tmp_path, "esac_tpu/lint/registry.py", """\
+        R11_WAIVED = {
+            "waived_fn": "fixture: covered transitively by registered_fn",
+        }
+
+        def _build():
+            from esac_tpu.ransac.entries import registered_fn
+            return registered_fn
+        """)
+    _write(tmp_path, "esac_tpu/ransac/entries.py", """\
+        from functools import partial
+
+        import jax
+
+        @jax.jit
+        def registered_fn(x):
+            return x
+
+        @partial(jax.jit, static_argnames=())
+        def waived_fn(x):
+            return x
+
+        @jax.jit
+        def rogue_fn(x):
+            return x
+
+        @jax.jit
+        def _private_helper(x):
+            return x
+
+        def make_rogue_factory(c):
+            @jax.jit
+            def inner(b):
+                return b
+            return inner
+
+        def make_plain_helper(c):
+            return c                       # no jit inside: not an entry
+        """)
+
+
+def test_r11_flags_unregistered_unwaived_entry_points(tmp_path):
+    _write_r11_tree(tmp_path)
+    findings = run_layer1(tmp_path)
+    assert _rules(findings) == ["R11", "R11"]
+    flagged = {f.message.split("'")[1] for f in findings}
+    assert flagged == {"rogue_fn", "make_rogue_factory"}
+
+
+def test_r11_skips_trees_without_a_registry(tmp_path):
+    # Fixture roots (and downstream checkouts) without lint/registry.py are
+    # not audited trees: no coverage gate.
+    _write(tmp_path, "esac_tpu/ransac/entries.py", """\
+        import jax
+
+        @jax.jit
+        def rogue_fn(x):
+            return x
+        """)
+    assert run_layer1(tmp_path) == []
+
+
+def test_r11_repo_registry_covers_every_discovered_entry_point():
+    """The day-one gaps are CLOSED: every public jitted entry point in the
+    package is registered (traced + audited + ledgered) or waived with a
+    reason — including the two PR-6 registrations."""
+    from esac_tpu.lint.ast_rules import _r11_discover, _r11_registry_names
+
+    registered, waived = _r11_registry_names(
+        (REPO / "esac_tpu/lint/registry.py").read_text()
+    )
+    names = {name for _, _, name in _r11_discover(REPO)}
+    assert "esac_infer_topk_frames" in names
+    assert "make_esac_infer_sharded_frames_dynamic" in names
+    assert "esac_infer_topk_frames" in registered
+    assert "make_esac_infer_sharded_frames_dynamic" in registered
+    uncovered = {n for n in names
+                 if n not in registered and n not in waived}
+    assert uncovered == set()
+    assert all(reason for reason in waived.values()), \
+        "every R11 waiver needs a reviewed reason"
+
+
+# --------------------------------------------------------------------------
 # R7: shell timeout/kill around python
 
 def test_r7_trigger_and_near_miss(tmp_path):
@@ -462,14 +897,72 @@ def test_cli_exit_2_on_malformed_baseline(tmp_path, capsys):
                       "--baseline", str(bad)]) == 2
 
 
+def _seed_violation(tmp_path):
+    return _write(tmp_path, "esac_tpu/geometry/r2.py",
+                  "import jax.numpy as jnp\n\ndef n(v):\n"
+                  "    return jnp.linalg.norm(v)\n")
+
+
+def test_cli_json_format_one_object_per_line(tmp_path, capsys):
+    _seed_violation(tmp_path)
+    rc = lint_main(["--root", str(tmp_path), "--no-jaxpr",
+                    "--format", "json"])
+    captured = capsys.readouterr()
+    assert rc == 1
+    lines = captured.out.strip().splitlines()
+    assert lines, "findings must ride stdout in json mode"
+    objs = [json.loads(line) for line in lines]     # every line parses
+    for o in objs:
+        assert {"id", "rule", "path", "line", "text", "message"} <= set(o)
+        assert o["id"].startswith(o["rule"] + "-")
+    # The human summary stays off stdout (driver consumes objects only).
+    assert "finding(s) over" not in captured.out
+    assert "finding(s) over" in captured.err
+
+
+def test_cli_json_ids_disambiguate_identical_lines(tmp_path, capsys):
+    # Two textually identical violations in one file share the baseline
+    # identity (rule, path, text) by design — the json ids must still be
+    # unique so a driver tracking resolution state never conflates them.
+    _write(tmp_path, "esac_tpu/geometry/twice.py",
+           "import jax.numpy as jnp\n\ndef a(v):\n"
+           "    return jnp.linalg.norm(v)\n\ndef b(v):\n"
+           "    return jnp.linalg.norm(v)\n")
+    rc = lint_main(["--root", str(tmp_path), "--no-jaxpr",
+                    "--format", "json"])
+    ids = [json.loads(l)["id"] for l in
+           capsys.readouterr().out.strip().splitlines()]
+    assert rc == 1 and len(ids) == 2
+    assert len(set(ids)) == 2
+    assert ids[1] == ids[0] + "-2"
+
+
+def test_cli_json_ids_are_stable_and_line_number_independent(tmp_path, capsys):
+    rel = _seed_violation(tmp_path)
+    lint_main(["--root", str(tmp_path), "--no-jaxpr", "--format", "json"])
+    ids1 = [json.loads(l)["id"] for l in
+            capsys.readouterr().out.strip().splitlines()]
+    # Shift the offending line down: same violation, same id.
+    p = tmp_path / rel
+    p.write_text("# a new comment line\n" + p.read_text())
+    lint_main(["--root", str(tmp_path), "--no-jaxpr", "--format", "json"])
+    ids2 = [json.loads(l)["id"] for l in
+            capsys.readouterr().out.strip().splitlines()]
+    assert ids1 == ids2 and len(ids1) == 1
+
+
 def test_changed_mode_audits_on_utils_edits():
     # utils/precision.py and utils/num.py carry the invariants the jaxpr
     # audit enforces; a --changed run touching them must include layer 2.
+    # The resource ledger rides the SAME condition (the ~20s tracing pass
+    # is skipped unless a traced package file changed).
     from esac_tpu.lint.cli import _audit_needed
 
     assert _audit_needed(["esac_tpu/utils/precision.py"])
     assert _audit_needed(["esac_tpu/utils/num.py"])
+    assert _audit_needed(None)      # full-tree runs always trace + ledger
     assert not _audit_needed(["tools/eval_agreement.py", "LINT.md"])
+    assert not _audit_needed(["bench.py", "tests/test_serve.py"])
 
 
 def test_cli_write_baseline_then_clean(tmp_path, capsys):
@@ -558,14 +1051,234 @@ def test_audit_recurses_into_scan_and_jit():
     assert [f.rule for f in findings] == ["J3"]  # found inside scan-in-pjit
 
 
-def test_registered_entry_points_audit_clean():
+def test_registered_entry_points_audit_clean(traced_registry):
     """The acceptance gate: every registry entry traces on CPU with zero
     disallowed primitives, static shapes, and pinned call graphs at
     HIGHEST/f32 — the jaxpr-level form of the CLAUDE.md conventions."""
     from esac_tpu.lint.jaxpr_audit import run_audit
 
-    findings = run_audit()
+    traced, _ = traced_registry
+    findings = run_audit(traced=traced)
     assert findings == [], "\n".join(f.format() for f in findings)
+
+
+# --------------------------------------------------------------------------
+# layer 2b: the jaxpr resource ledger (graft-audit v2)
+
+def _mini_stats(nbytes, flops, census):
+    return {
+        "pinned": True, "flops": flops, "peak_intermediate_bytes": nbytes,
+        "dot_general_count": sum(census.values()), "dot_census": census,
+        "top_intermediates": [],
+    }
+
+
+def test_ledger_entry_stats_census_flops_and_peak():
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.lint.ledger import entry_stats
+    from esac_tpu.utils.precision import hmm
+
+    a = jnp.zeros((4, 4))
+    s = entry_stats(jax.make_jaxpr(lambda x: hmm(x, x) + 1.0)(a))
+    assert s["dot_census"] == {"HIGHEST:float32": 1}
+    assert s["dot_general_count"] == 1
+    assert s["flops"] >= 2 * 4 * 4 * 4          # the contraction dominates
+    assert s["peak_intermediate_bytes"] >= 2 * 4 * 4 * 4  # dot out + add out
+    assert s["top_intermediates"][0]["bytes"] == 64
+    # The identical trace through a default-precision matmul flips the
+    # census key — exactly the signal the pin-drop gate diffs on.
+    s2 = entry_stats(jax.make_jaxpr(lambda x: jnp.matmul(x, x) + 1.0)(a))
+    assert list(s2["dot_census"]) == ["DEFAULT:float32"]
+
+
+def test_ledger_flops_multiply_scan_trip_counts():
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.lint.ledger import entry_stats
+
+    def f(x):
+        def body(c, _):
+            return c * 2.0, None
+
+        out, _ = jax.lax.scan(body, x, None, length=5)
+        return out
+
+    s = entry_stats(jax.make_jaxpr(f)(jnp.zeros((8,))))
+    assert s["flops"] >= 5 * 8      # body flops x trip count
+
+
+def test_ledger_roundtrip(tmp_path):
+    from esac_tpu.lint.ledger import diff_ledger, load_ledger, write_ledger
+
+    entries = {"e": _mini_stats(1000, 2000, {"HIGHEST:float32": 3})}
+    path = tmp_path / "ledger.json"
+    write_ledger(path, entries)
+    loaded = load_ledger(path)
+    findings, stale = diff_ledger(loaded, entries)
+    assert findings == [] and stale == []
+    assert load_ledger(tmp_path / "missing.json") is None
+
+
+def test_ledger_diff_fails_on_materialization_regression():
+    from esac_tpu.lint.ledger import diff_ledger
+
+    old = {"e": _mini_stats(1000, 1000, {"HIGHEST:float32": 3})}
+    # 2x peak bytes: the "silently doubles an entry's materialization" case.
+    doubled = {"e": _mini_stats(2000, 1000, {"HIGHEST:float32": 3})}
+    findings, _ = diff_ledger(old, doubled)
+    assert [f.rule for f in findings] == ["J4"]
+    assert "peak_intermediate_bytes" in findings[0].text
+    # Within tolerance: no failure, but the drift is reported stale.
+    nudged = {"e": _mini_stats(1100, 1000, {"HIGHEST:float32": 3})}
+    findings, stale = diff_ledger(old, nudged)
+    assert findings == [] and len(stale) == 1
+    # Improvement: never a failure, still stale (regenerate + review).
+    better = {"e": _mini_stats(500, 500, {"HIGHEST:float32": 3})}
+    findings, stale = diff_ledger(old, better)
+    assert findings == [] and len(stale) == 1
+
+
+def test_ledger_diff_fails_on_dropped_highest_pin():
+    from esac_tpu.lint.ledger import diff_ledger
+
+    old = {"e": _mini_stats(1000, 1000,
+                            {"HIGHEST:float32": 3, "DEFAULT:float32": 2})}
+    new = {"e": _mini_stats(1000, 1000,
+                            {"HIGHEST:float32": 2, "DEFAULT:float32": 3})}
+    findings, _ = diff_ledger(old, new)
+    assert [f.rule for f in findings] == ["J4"]
+    assert "HIGHEST" in findings[0].message
+    # Adding a NEW unpinned dot without losing a pin is census drift
+    # (stale), not a pin drop — the bytes/flops gates cover real growth.
+    grown = {"e": _mini_stats(1000, 1000,
+                              {"HIGHEST:float32": 3, "DEFAULT:float32": 3})}
+    findings, stale = diff_ledger(old, grown)
+    assert findings == [] and len(stale) == 1
+
+
+def test_ledger_diff_missing_and_stale_entries():
+    from esac_tpu.lint.ledger import diff_ledger
+
+    stats = _mini_stats(1000, 1000, {"HIGHEST:float32": 3})
+    # New entry with no committed record: fail (the coverage gate's ledger
+    # sibling) — except when the entry was skipped as untraceable.
+    findings, stale = diff_ledger({}, {"new": stats})
+    assert [f.rule for f in findings] == ["J4"]
+    assert "missing-entry" in findings[0].text
+    # Committed entry whose registry entry is gone: stale, not a failure.
+    findings, stale = diff_ledger({"gone": stats}, {})
+    assert findings == [] and len(stale) == 1
+    # Skipped (untraceable in this process): neither failure nor stale.
+    findings, stale = diff_ledger({"mesh_entry": stats}, {}, {"mesh_entry"})
+    assert findings == [] and stale == []
+
+
+def test_cli_ledger_gate_exits_1_on_materialization_regression(
+    tmp_path, monkeypatch, capsys
+):
+    """End-to-end form of the diff gate: a committed ledger recording HALF
+    the current peak bytes (i.e. the tree silently doubled an entry's
+    materialization) must fail the CLI with exit 1 and a J4 finding; the
+    honest committed ledger exits 0."""
+    import jax
+    import jax.numpy as jnp
+
+    import esac_tpu.lint.jaxpr_audit as audit_mod
+    from esac_tpu.lint.ledger import LEDGER_NAME, build_ledger, write_ledger
+    from esac_tpu.lint.registry import Entry
+
+    closed = jax.make_jaxpr(lambda x: x @ x + 1.0)(jnp.zeros((4, 4)))
+    fake = [(Entry("fixture_entry", pinned=False, build=lambda: None), closed)]
+    monkeypatch.setattr(audit_mod, "trace_entries", lambda entries=None: fake)
+    _write(tmp_path, "esac_tpu/ok.py", "import numpy as np\n")
+
+    current, _ = build_ledger(fake)
+    write_ledger(tmp_path / LEDGER_NAME, current)
+    assert lint_main(["--root", str(tmp_path)]) == 0
+
+    doctored = {
+        name: {**stats, "peak_intermediate_bytes":
+               stats["peak_intermediate_bytes"] // 2}
+        for name, stats in current.items()
+    }
+    write_ledger(tmp_path / LEDGER_NAME, doctored)
+    rc = lint_main(["--root", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert " J4 " in out and "peak_intermediate_bytes" in out
+
+
+def test_committed_ledger_matches_tree_exactly(traced_registry):
+    """The tier-1 ledger gate: the committed .jaxpr_ledger.json equals the
+    recomputed ledger bit-for-bit (tracing is deterministic on this
+    container) — any drift means regenerate-and-review, any regression
+    means exit 1 (diff gate)."""
+    from esac_tpu.lint.ledger import (
+        LEDGER_NAME,
+        build_ledger,
+        diff_ledger,
+        load_ledger,
+    )
+
+    traced, _ = traced_registry
+    current, skipped = build_ledger(traced)
+    committed = load_ledger(REPO / LEDGER_NAME)
+    assert committed is not None, "no committed ledger: run --write-ledger"
+    findings, stale = diff_ledger(committed, current, skipped)
+    assert findings == [], "\n".join(f.format() for f in findings)
+    assert stale == [], "\n".join(stale)
+    current_json = json.loads(json.dumps(current))
+    for name, cur in current_json.items():
+        assert committed.get(name) == cur, f"ledger drift in {name}"
+
+
+def test_committed_ledger_quantifies_the_scoring_errmap():
+    """DESIGN.md §9's "scoring materializes per-hypothesis errmaps" claim
+    as a committed number: the esac_infer_frames entry records the errmap
+    footprint and that a tensor of exactly that size rides the trace."""
+    from esac_tpu.lint.ledger import LEDGER_NAME, load_ledger
+
+    committed = load_ledger(REPO / LEDGER_NAME)
+    e = committed["esac_infer_frames"]["errmap"]
+    dims = e["trace_dims"]
+    assert e["bytes_at_trace_shapes"] == (
+        dims["B"] * dims["M"] * dims["n_hyps"] * dims["n_cells"] * 4
+    )
+    assert e["present_in_trace"] is True
+    assert committed["scoring_errmap_grad"]["errmap"]["present_in_trace"]
+    # And the entry-level peaks the fusion argument needs are committed.
+    for name in ("esac_infer_frames", "scoring_errmap_grad"):
+        entry = committed[name]
+        assert entry["peak_intermediate_bytes"] > 0
+        assert entry["flops"] > 0
+        assert entry["dot_census"]
+
+
+def test_lint_wall_clock_recorded_and_inside_budget(traced_registry):
+    """Record the lint gate's own wall clock in .tier1_wall.json (merged —
+    conftest preserves foreign keys) so the tier-1 budget math is visible:
+    layer 1 + one shared tracing pass must stay a small fraction of 870s."""
+    _, trace_s = traced_registry
+    t0 = time.perf_counter()
+    run_layer1(REPO)
+    layer1_s = time.perf_counter() - t0
+    total = trace_s + layer1_s
+    wall_file = REPO / ".tier1_wall.json"
+    record = {}
+    if wall_file.exists():
+        try:
+            record = json.loads(wall_file.read_text())
+        except (OSError, ValueError):
+            record = {}
+    record["lint_wall_s"] = round(total, 1)
+    wall_file.write_text(json.dumps(record))
+    assert total < 240, (
+        f"lint gate took {total:.0f}s — too large a share of the 870s "
+        "tier-1 budget; trim the registry trace shapes"
+    )
 
 
 # --------------------------------------------------------------------------

@@ -21,6 +21,25 @@ def test_device_count_is_8():
     assert jax.device_count() == 8
 
 
+def test_sharded_infer_body_is_cached_per_mesh_cfg():
+    """Regression for the graft-audit v2 (R9) finding: esac_infer_sharded
+    used to rebuild + re-jit its shard_map body on EVERY direct call
+    (``jax.jit(body)(...)`` inline), so each call retraced and recompiled.
+    The body is now an lru_cached builder keyed on (mesh, cfg): repeated
+    calls must reuse one wrapper (whose jit cache then dedupes compiles)."""
+    from esac_tpu.parallel.esac_sharded import _sharded_infer_fn
+
+    mesh = make_mesh(n_data=1, n_expert=8)
+    before = _sharded_infer_fn.cache_info().hits
+    fn_a = _sharded_infer_fn(mesh, CFG)
+    fn_b = _sharded_infer_fn(mesh, CFG)
+    assert fn_a is fn_b
+    assert _sharded_infer_fn.cache_info().hits == before + 1
+    # A different static config is a different program, not a cache hit.
+    other = _sharded_infer_fn(mesh, RansacConfig(n_hyps=8))
+    assert other is not fn_a
+
+
 def make_expert_maps(key, M, correct):
     frame = make_correspondence_frame(key, noise=0.01, **FRAME_KW)
     n = frame["coords"].shape[0]

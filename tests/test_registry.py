@@ -232,6 +232,41 @@ def test_lru_eviction_order_under_byte_budget():
     assert list(cache.evictions) == [("a", 1), ("c", 1), ("b", 1)]
 
 
+def test_cache_introspection_holds_the_lock():
+    """Regression for the graft-audit v2 (R10) findings: ``bytes_in_use``
+    and ``len(cache)`` used to read the LRU structures without the lock —
+    a torn read under a concurrent ``get``-triggered eviction.  Both must
+    acquire the instance lock now (lock-discipline invariant)."""
+    import threading
+
+    cache = DeviceWeightCache(
+        lambda e: {"w": np.zeros(256, np.float32)}, budget_bytes=None
+    )
+    cache.get(_FakeEntry("a"))
+
+    class _ProbeLock:
+        def __init__(self):
+            self.acquisitions = 0
+            self._inner = threading.Lock()
+
+        def __enter__(self):
+            self.acquisitions += 1
+            return self._inner.__enter__()
+
+        def __exit__(self, *exc):
+            return self._inner.__exit__(*exc)
+
+    probe = cache._lock = _ProbeLock()
+    assert cache.bytes_in_use == 1024
+    assert len(cache) == 1
+    assert ("a", 1) in cache
+    cache.stats()
+    assert probe.acquisitions == 4, (
+        "every introspection entry point must take the instance lock "
+        "exactly once"
+    )
+
+
 def test_cache_admits_oversized_entry_alone():
     cache = DeviceWeightCache(
         lambda e: {"w": np.zeros(1024, np.float32)}, budget_bytes=100
