@@ -352,6 +352,35 @@ class SceneRegistry:
         """Pre-stage a scene's active weights (cold-load off the hot path)."""
         self.cache.get(self.manifest.resolve(scene_id))
 
+    def prewarm_programs(self, scene_id: str, frame_buckets,
+                         route_ks=(None,)) -> int:
+        """Compile (and run once, on zero frames) every (K, frame-bucket)
+        program a scene's traffic — including an SLO degradation ladder
+        (serve.slo.SLOPolicy.degrade_route_k) — can reach, OFF the hot
+        path.  Degrading under overload swaps a lane to a cheaper
+        already-compiled static program (DESIGN.md §12); prewarming is
+        what makes even the *first* degraded dispatch recompile-free.
+        Returns the compiled-program count afterwards (the jit cache-miss
+        counter tests pin across degrade events)."""
+        import jax
+
+        from esac_tpu.serve.batching import MIN_LANES
+
+        entry = self.manifest.resolve(scene_id)
+        params = self.cache.get(entry)
+        for k in route_ks:
+            fn = self._fn_for(entry, k)
+            for bucket in sorted(set(frame_buckets)):
+                B = max(int(bucket), MIN_LANES)
+                batch = {
+                    "key": jax.random.split(jax.random.key(0), B),
+                    "image": jax.numpy.zeros(
+                        (B, entry.preset.height, entry.preset.width, 3)
+                    ),
+                }
+                jax.block_until_ready(fn(params, batch))
+        return self.compile_cache_size()
+
     def dispatcher(self, cfg: RansacConfig = RansacConfig(),
                    start_worker: bool = True, **kw):
         """A scene-aware MicroBatchDispatcher over this registry.  ``cfg``

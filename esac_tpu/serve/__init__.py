@@ -4,7 +4,10 @@ DESIGN.md §9's ≥2x lever made code: single-frame requests are coalesced
 into fixed, bucketed frame-batch dispatches so the serial small-tensor
 chain (P3P, argmax selection, winner-only IRLS) pays its op-latency floor
 once per *dispatch* instead of once per frame.  See serve.batching for the
-static-shape/padding invariants and serve.dispatcher for the request path.
+static-shape/padding invariants, serve.dispatcher for the request path,
+serve.slo for the SLO machinery (deadlines, admission control, graceful
+degradation, watchdog — DESIGN.md §12) and serve.loadgen for the
+open-loop load harness that measures it all.
 """
 
 from esac_tpu.serve.batching import (
@@ -20,15 +23,43 @@ from esac_tpu.serve.dispatcher import (
     make_esac_serve_fn,
     make_sharded_serve_fn,
 )
+from esac_tpu.serve.loadgen import (
+    poisson_arrivals,
+    run_open_loop,
+    uniform_arrivals,
+)
+from esac_tpu.serve.slo import (
+    DeadlineExceededError,
+    DispatcherClosedError,
+    DispatchStalledError,
+    FaultInjector,
+    LaneQuarantinedError,
+    ServeError,
+    ShedError,
+    SLOPolicy,
+    WorkerDiedError,
+)
 
 __all__ = [
     "MIN_LANES",
     "MicroBatchDispatcher",
+    "DeadlineExceededError",
+    "DispatcherClosedError",
+    "DispatchStalledError",
+    "FaultInjector",
+    "LaneQuarantinedError",
+    "ServeError",
+    "ShedError",
+    "SLOPolicy",
+    "WorkerDiedError",
     "make_dsac_serve_fn",
     "make_esac_serve_fn",
     "make_sharded_serve_fn",
     "pad_batch",
     "pick_bucket",
     "plan_dispatches",
+    "poisson_arrivals",
+    "run_open_loop",
     "stack_frames",
+    "uniform_arrivals",
 ]

@@ -35,11 +35,31 @@ serve fn resolves weights from its device cache per dispatch), routed
 ones ``infer_fn(tree, scene, route_k)``; scene-less requests keep the
 original ``infer_fn(tree)`` contract, byte-for-byte.
 
-Every stat the dispatcher keeps (latencies, dispatch/scene/route logs) is
-a ring buffer sized by ``stats_window``; the per-lane ``dispatch_counts``
-totals are keyed by (scene, route_k), bounded by the fleet, not by
-traffic — a week-long server's host memory stays flat (regression-pinned
-in tests/test_serve.py).
+SLO serving (DESIGN.md §12; esac_tpu.serve.slo): passing an ``slo``
+policy opts the request path into per-request deadlines
+(``submit``/``infer_one`` take ``deadline_ms``/``timeout``), bounded-queue
+admission control (a full queue or a predicted deadline miss SHEDS with a
+typed :class:`~esac_tpu.serve.slo.ShedError` instead of blocking — the
+open-loop contract; bulk ``infer_many`` keeps blocking backpressure),
+graceful degradation (under overload a lane's ``route_k`` downshifts one
+rung of ``slo.degrade_route_k`` — a cheaper ALREADY-COMPILED static
+program, never a recompile), and a watchdog thread that bounds the
+environment's observed relay-stall failure mode: a dispatch that makes no
+progress within ``slo.watchdog_ms`` has its requests failed with
+:class:`~esac_tpu.serve.slo.DispatchStalledError` *within their
+deadline*, its lane quarantined, and a replacement worker takes over the
+healthy lanes instead of the whole server hanging.  Every request's fate
+lands in the outcome accounting — served / shed / expired / degraded /
+failed — which sums exactly to ``offered`` (pinned in
+tests/test_serve_slo.py).  Whether or not a policy is set, ``close()``
+and a dying worker wake every pending caller with a typed error; nobody
+strands forever on a dead server.
+
+Every stat the dispatcher keeps (latencies, dispatch/scene/route/outcome
+logs) is a ring buffer sized by ``stats_window``; the per-lane
+``dispatch_counts`` / outcome totals are keyed by (scene, route_k),
+bounded by the fleet, not by traffic — a week-long server's host memory
+stays flat (regression-pinned in tests/test_serve.py).
 """
 
 from __future__ import annotations
@@ -55,13 +75,29 @@ from esac_tpu.serve.batching import (
     plan_dispatches,
     stack_frames,
 )
+from esac_tpu.serve.slo import (
+    DeadlineExceededError,
+    DispatcherClosedError,
+    DispatchStalledError,
+    LaneQuarantinedError,
+    ShedError,
+    SLOPolicy,
+    WorkerDiedError,
+)
 
 
 class _Request:
-    __slots__ = ("frame", "scene", "route_k", "event", "result", "error",
-                 "t_submit")
+    """One queued frame.  ``result``/``error`` are plain attributes for
+    back-compat; :meth:`get` is the timeout-taking accessor every new
+    caller should use (a bare ``event.wait()`` on a dead server is the
+    exact unbounded-blocking bug this layer exists to kill)."""
 
-    def __init__(self, frame, t_submit, scene=None, route_k=None):
+    __slots__ = ("frame", "scene", "route_k", "event", "result", "error",
+                 "t_submit", "t_done", "deadline", "done", "outcome",
+                 "owner")
+
+    def __init__(self, frame, t_submit, scene=None, route_k=None,
+                 deadline=None, owner=None):
         self.frame = frame
         self.scene = scene
         self.route_k = route_k
@@ -69,6 +105,45 @@ class _Request:
         self.result = None
         self.error = None
         self.t_submit = t_submit
+        self.t_done = None
+        self.deadline = deadline  # absolute clock() time, or None
+        self.done = False
+        self.outcome = None       # served|shed|expired|degraded|failed
+        self.owner = owner        # dispatcher, for timeout abandonment
+
+    def get(self, timeout: float | None = None):
+        """Wait up to ``timeout`` seconds for the result; raises the
+        request's typed error, or :class:`DeadlineExceededError` on
+        timeout.  A timeout ABANDONS the request — same semantics as
+        ``infer_one``'s timeout: it is marked expired, a late result is
+        discarded, and the accounting agrees with what this call raised.
+        The dispatcher guarantees the event fires on close, worker death
+        and watchdog abandonment, so a bounded wait here is a real
+        bound, not a hope."""
+        if not self.event.wait(timeout):
+            err = DeadlineExceededError(
+                f"no result within {timeout}s — request abandoned"
+            )
+            if self.owner is not None:
+                self.owner._abandon(self, err)
+            if self.error is not None:  # resolved in the race window
+                raise self.error
+            if not self.done:
+                raise err  # ownerless request (sync path): nothing to mark
+            return self.result
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class _Inflight:
+    __slots__ = ("gen", "lane", "reqs", "t_start")
+
+    def __init__(self, gen, lane, reqs, t_start):
+        self.gen = gen
+        self.lane = lane
+        self.reqs = reqs
+        self.t_start = t_start
 
 
 class MicroBatchDispatcher:
@@ -80,6 +155,9 @@ class MicroBatchDispatcher:
     ``start_worker=False`` skips the background thread: ``infer_one``
     dispatches synchronously (per-frame-call semantics) and ``infer_many``
     is unaffected — the mode used by benchmarks and equivalence tests.
+    ``slo`` (an :class:`~esac_tpu.serve.slo.SLOPolicy`) opts into the
+    deadline / admission-control / degradation / watchdog machinery; None
+    preserves the PR-2 blocking contract byte-for-byte.
     """
 
     def __init__(
@@ -89,6 +167,7 @@ class MicroBatchDispatcher:
         start_worker: bool = True,
         clock=time.perf_counter,
         stats_window: int = 10_000,
+        slo: SLOPolicy | None = None,
     ):
         if stats_window < 1:
             raise ValueError(f"stats_window {stats_window} < 1")
@@ -97,6 +176,7 @@ class MicroBatchDispatcher:
         self._max_wait_s = cfg.serve_max_wait_ms / 1e3
         self._depth = cfg.serve_queue_depth
         self._clock = clock
+        self._slo = slo
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)   # waiters: worker
         self._space = threading.Condition(self._lock)  # waiters: submitters
@@ -110,15 +190,27 @@ class MicroBatchDispatcher:
         )
         self._n_pending = 0
         self._closed = False
+        # SLO state (all guarded by self._lock, graft-lint R10): the worker
+        # generation counter lets the watchdog abandon a wedged worker — a
+        # stale-generation worker discards whatever it eventually returns
+        # and exits; quarantined maps lane -> reason; the dispatch-time EMA
+        # feeds admission control's predicted-wait estimate.
+        self._gen = 0
+        self._inflight: _Inflight | None = None
+        self._quarantined: dict[tuple, str] = {}
+        self._fail_streak: collections.Counter = collections.Counter()
+        self._ema_dispatch_s = 0.0
+        self._ema_n = 0  # completed-dispatch samples behind the EMA
+        self._worker_dead: str | None = None
         # Bounded stats: a serving process runs for days — EVERY per-request
         # and per-dispatch record here is a ring buffer, sized by
         # ``stats_window`` dispatches, or latency_quantiles() would sort an
         # unbounded history under the dispatch lock and host memory would
         # grow without limit (pinned by the long-stream regression test in
         # tests/test_serve.py).  Quantiles are over the recent window; the
-        # only unbounded-looking structure left is ``dispatch_counts``,
-        # which is keyed by (scene, route_k) lane and therefore bounded by
-        # the fleet's scene count, not by traffic.
+        # only unbounded-looking structures left are ``dispatch_counts``
+        # and the outcome counters, keyed by (scene, route_k) lane /
+        # outcome class and therefore bounded by the fleet, not by traffic.
         self.latencies_s: collections.deque[float] = collections.deque(
             maxlen=10 * stats_window
         )
@@ -135,7 +227,18 @@ class MicroBatchDispatcher:
         )
         # Lifetime totals per lane (fairness monitoring without a log).
         self.dispatch_counts: collections.Counter = collections.Counter()
+        # SLO accounting: every request ever offered ends in exactly one
+        # outcome class — served / shed / expired / degraded / failed —
+        # and the classes sum to ``offered`` (the acceptance invariant,
+        # pinned in tests/test_serve_slo.py).  ``outcome_log`` is the
+        # ring-bounded per-request trail (outcome, scene, route_k, eff_k).
+        self.offered = 0
+        self.outcome_counts: collections.Counter = collections.Counter()
+        self.outcome_log: collections.deque = collections.deque(
+            maxlen=stats_window
+        )
         self._worker = None
+        self._watchdog = None
         if start_worker:
             self.start()
 
@@ -145,26 +248,79 @@ class MicroBatchDispatcher:
         the deterministic sequencing the coalescing tests rely on.  Don't
         race start() against ``infer_one`` from other threads: infer_one
         picks its (sync vs queued) path by whether a worker exists."""
-        if self._worker is None:
-            self._worker = threading.Thread(
-                target=self._worker_loop, daemon=True, name="esac-serve"
-            )
-            self._worker.start()
+        with self._work:
+            if self._worker is None:
+                self._worker = self._spawn_worker()
+            if self._slo is not None and self._watchdog is None:
+                self._watchdog = threading.Thread(
+                    target=self._watchdog_loop, daemon=True,
+                    name="esac-serve-watchdog",
+                )
+                self._watchdog.start()
+
+    def _spawn_worker(self) -> threading.Thread:
+        """Build + start a worker thread bound to the CURRENT generation
+        (lock held)."""
+        t = threading.Thread(
+            target=self._worker_loop, args=(self._gen,), daemon=True,
+            name="esac-serve",
+        )
+        t.start()
+        return t
 
     # ---------------- request path ----------------
 
-    def submit(self, frame: dict, scene=None, route_k=None) -> _Request:
+    def submit(self, frame: dict, scene=None, route_k=None,
+               deadline_ms: float | None = None) -> _Request:
         """Enqueue one frame tree (optionally for a registry ``scene`` and
         a routed top-K program ``route_k``); returns a request whose
-        ``event`` fires when ``result`` (or ``error``) is set.  Blocks for
-        queue space — backpressure across ALL lanes, never drops."""
-        req = _Request(frame, self._clock(), scene, route_k)
+        ``event`` fires when ``result`` (or ``error``) is set.
+
+        Without an SLO policy: blocks for queue space — backpressure
+        across ALL lanes, never drops (the PR-2 contract).  With one:
+        admission control instead — a full queue, a quarantined lane, or
+        a predicted deadline miss raises a typed
+        :class:`~esac_tpu.serve.slo.ShedError` subclass immediately, and
+        the request carries ``deadline_ms`` (default
+        ``slo.deadline_ms``)."""
+        t_submit = self._clock()
+        # An EXPLICIT deadline_ms is honored with or without a policy —
+        # silently ignoring a requested bound would reintroduce the
+        # unbounded-blocking bug for exactly the caller who asked not to
+        # have it; the policy only supplies the default.
+        if deadline_ms is None and self._slo is not None:
+            deadline_ms = self._slo.deadline_ms
+        deadline = (t_submit + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        req = _Request(frame, t_submit, scene, route_k, deadline, owner=self)
         lane = (scene, route_k)
         with self._work:
-            while self._n_pending >= self._depth and not self._closed:
-                self._space.wait()
-            if self._closed:
-                raise RuntimeError("dispatcher is closed")
+            if self._slo is None:
+                # Legacy backpressure — but a request WITH a deadline must
+                # not strand in the space wait either: the bound applies
+                # from the first instant, not only once queued.
+                while self._n_pending >= self._depth and not self._closed \
+                        and self._worker_dead is None:
+                    remaining = (None if deadline is None
+                                 else deadline - self._clock())
+                    if remaining is not None and remaining <= 0:
+                        self.offered += 1
+                        self.outcome_counts["expired"] += 1
+                        self.outcome_log.append(
+                            ("expired", scene, route_k, None)
+                        )
+                        raise DeadlineExceededError(
+                            "deadline expired waiting for queue space"
+                        )
+                    self._space.wait(remaining)
+            self._raise_if_unservable()
+            self.offered += 1
+            if self._slo is not None:
+                why = self._admission_reject(lane, req, t_submit)
+                if why is not None:
+                    self.outcome_counts["shed"] += 1
+                    self.outcome_log.append(("shed", scene, route_k, None))
+                    raise why
             q = self._pending.get(lane)
             if q is None:
                 q = self._pending[lane] = collections.deque()
@@ -173,14 +329,118 @@ class MicroBatchDispatcher:
             self._work.notify()
         return req
 
-    def infer_one(self, frame: dict, scene=None, route_k=None) -> dict:
-        """Blocking single-frame inference through the batching queue."""
-        if self._worker is None:
-            req = _Request(frame, self._clock(), scene, route_k)
-            self._run([req], scene, route_k)
+    def _raise_if_unservable(self):
+        """Reject submissions to a server that can no longer serve them
+        (lock held): closed, or the worker thread died with the queue —
+        the typed replacement for stranding callers forever."""
+        if self._worker_dead is not None:
+            raise WorkerDiedError(self._worker_dead)
+        if self._closed:
+            raise DispatcherClosedError("dispatcher is closed")
+
+    def _abandon(self, req: _Request, err) -> None:
+        """Caller-side timeout: mark ``req`` expired so the worker skips
+        it (if still queued) or its late result is discarded (if in
+        flight) — the accounting then agrees with the error the caller
+        saw.  No-op if the request already resolved."""
+        with self._work:
+            self._finish(req, error=err, outcome="expired")
+
+    def _admission_reject(self, lane, req, now):
+        """SLO admission control (lock held): the typed error to raise, or
+        None to admit.  Sheds on quarantine, a full bounded queue, and a
+        predicted deadline miss (dispatch-time EMA x dispatches queued
+        ahead — rejecting in microseconds beats serving a corpse late).
+        Predicted-miss shedding needs >= 2 completed dispatches behind
+        the EMA: a single sample may be a compile-inflated outlier, and
+        shedding on it would poison a healthy server forever (nothing
+        would ever dispatch to correct the estimate)."""
+        reason = self._quarantined.get(lane)
+        if reason is not None:
+            return LaneQuarantinedError(
+                f"lane {lane} is quarantined ({reason}); release_lane() "
+                "after the fault is cleared"
+            )
+        if self._n_pending >= self._depth:
+            return ShedError(
+                f"queue full ({self._n_pending}/{self._depth} pending)"
+            )
+        if (self._slo.shed_on_predicted_miss and req.deadline is not None
+                and self._ema_n >= 2):
+            # Dispatches needed before this request's own dispatch lands:
+            # everything already queued, bucket-coalesced, plus its own.
+            ahead = 1 + self._n_pending // self._buckets[-1]
+            predicted = now + ahead * self._ema_dispatch_s
+            if predicted > req.deadline:
+                return ShedError(
+                    f"predicted wait {ahead * self._ema_dispatch_s * 1e3:.1f}ms "
+                    f"exceeds deadline "
+                    f"({(req.deadline - now) * 1e3:.1f}ms remaining)"
+                )
+        return None
+
+    def infer_one(self, frame: dict, scene=None, route_k=None,
+                  timeout: float | None = None,
+                  deadline_ms: float | None = None) -> dict:
+        """Blocking single-frame inference through the batching queue.
+
+        ``timeout`` bounds the wait in seconds (independent of any SLO);
+        ``deadline_ms`` rides the request into the queue (SLO mode).  On a
+        deadline/timeout the request is abandoned — marked expired so a
+        late result is discarded — and a typed
+        :class:`DeadlineExceededError` is raised: no caller ever blocks
+        past its deadline, even when the dispatch path is wedged.
+
+        The worker-less sync mode (``start_worker=False``) executes the
+        dispatch in the CALLER's thread, so a wedged ``infer_fn`` cannot
+        be interrupted there; the bounds are instead enforced at
+        completion — a result landing past ``deadline_ms``/``timeout``
+        raises :class:`DeadlineExceededError` (outcome expired) rather
+        than being returned as served."""
+        with self._work:
+            has_worker = self._worker is not None
+        if not has_worker:
+            t_submit = self._clock()
+            if deadline_ms is None and self._slo is not None:
+                deadline_ms = self._slo.deadline_ms
+            bounds = ([t_submit + deadline_ms / 1e3]
+                      if deadline_ms is not None else [])
+            bounds += [t_submit + timeout] if timeout is not None else []
+            req = _Request(frame, t_submit, scene, route_k,
+                           min(bounds) if bounds else None, owner=self)
+            with self._work:
+                self._raise_if_unservable()
+                self.offered += 1
+                # Same lock acquisition as the offered count: the request
+                # must never be observable in neither table (the invariant
+                # holds at every instant on the sync path too).
+                self._inflight = _Inflight(None, (scene, route_k), [req],
+                                           t_submit)
+            self._run([req], (scene, route_k), route_k, False, None)
         else:
-            req = self.submit(frame, scene, route_k)
-            req.event.wait()
+            if deadline_ms is None and timeout is not None:
+                # The timeout is an end-to-end bound: riding it into the
+                # queue as the deadline bounds the space wait and queue
+                # residency too, not just the event wait at the end.
+                deadline_ms = timeout * 1e3
+            req = self.submit(frame, scene, route_k, deadline_ms)
+            limit = timeout
+            if req.deadline is not None:
+                # Clamp to the REMAINING deadline window: submit() may
+                # have consumed part of it in the space wait, and a fresh
+                # full `timeout` anchored here would let the caller block
+                # up to ~2x the requested end-to-end bound.
+                remaining = max(0.0, req.deadline - self._clock())
+                limit = remaining if limit is None else min(limit, remaining)
+            if not req.event.wait(limit):
+                self._abandon(
+                    req,
+                    DeadlineExceededError(
+                        f"request exceeded its "
+                        f"{'deadline' if timeout is None else 'timeout'} "
+                        f"after {(self._clock() - req.t_submit) * 1e3:.1f}ms"
+                    ),
+                )
         if req.error is not None:
             raise req.error
         return req.result
@@ -189,7 +449,10 @@ class MicroBatchDispatcher:
                    route_k=None) -> list[dict]:
         """Bulk inference: bucket-planned dispatches, staging double-buffered
         against in-flight compute.  Returns per-frame result trees (host
-        numpy), in input order."""
+        numpy), in input order.  Bulk submission is inherently
+        backpressured — each dispatch blocks the caller — so SLO admission
+        control does not apply here; outcomes still land in the
+        accounting."""
         import jax
         import numpy as np
 
@@ -223,6 +486,15 @@ class MicroBatchDispatcher:
                     pick_bucket(n_valid, self._buckets), n_valid, scene,
                     route_k, [t_done - t_submit] * n_valid,
                 )
+                self.offered += n_valid
+                self.outcome_counts["served"] += n_valid
+                # Bulk serves ride the per-request trail too: the ring and
+                # the counters must tell one story on a mixed-traffic
+                # server.
+                self.outcome_log.extend(
+                    ("served", scene, route_k, route_k)
+                    for _ in range(n_valid)
+                )
             results.extend(
                 jax.tree.map(lambda x: x[j], host) for j in range(n_valid)
             )
@@ -249,46 +521,280 @@ class MicroBatchDispatcher:
         self.dispatch_counts[(scene, route_k)] += 1
         self.latencies_s.extend(latencies)
 
-    def _worker_loop(self):
+    def _finish(self, req: _Request, result=None, error=None,
+                outcome: str = "served", eff_k=None) -> bool:
+        """Resolve one request exactly once (lock held).  Returns False if
+        the request was already resolved — a late result from an abandoned
+        (wedged, expired) dispatch is DISCARDED here, which is what makes
+        watchdog/timeout abandonment safe against the worker eventually
+        unsticking."""
+        if req.done:
+            return False
+        req.done = True
+        req.result = result
+        req.error = error
+        req.outcome = outcome
+        req.t_done = self._clock()
+        self.outcome_counts[outcome] += 1
+        self.outcome_log.append((outcome, req.scene, req.route_k, eff_k))
+        req.event.set()
+        return True
+
+    def _drain_lane(self, lane, error_factory, outcome: str) -> None:
+        """Fail every request still queued on ``lane`` (lock held) — used
+        when the lane is quarantined so its backlog cannot re-wedge the
+        replacement worker."""
+        q = self._pending.pop(lane, None)
+        if q is None:
+            return
+        for r in q:
+            if r.done:
+                self._n_pending -= 1
+            elif self._finish(r, error=error_factory(), outcome=outcome):
+                self._n_pending -= 1
+        self._space.notify_all()
+
+    def _prepare_batch(self, batch: list[_Request], lane):
+        """SLO pre-dispatch pass (lock held): drop requests that are
+        already resolved (abandoned by their caller) or past their
+        deadline, and decide the dispatch's effective route_k — under
+        overload the lane downshifts one rung of the degradation ladder
+        (a cheaper static program from the SAME compiled family; never a
+        recompile).  Returns (live requests, effective_k, degraded?)."""
+        scene, route_k = lane
+        now = self._clock()
+        live = []
+        for r in batch:
+            if r.done:
+                continue  # abandoned by its caller; outcome already counted
+            # Drop only the ACTUALLY expired: a predicted-to-miss request
+            # rides the dispatch anyway (padding makes the lane free, and
+            # if the EMA was a compile-inflated outlier the completion
+            # corrects it); a completion that really lands late counts
+            # expired at fan-out, never served.
+            if r.deadline is not None and now > r.deadline:
+                self._finish(
+                    r,
+                    error=DeadlineExceededError(
+                        f"expired in queue after "
+                        f"{(now - r.t_submit) * 1e3:.1f}ms"
+                    ),
+                    outcome="expired",
+                )
+                continue
+            live.append(r)
+        eff_k, degraded = route_k, False
+        if (live and self._slo is not None
+                and (scene is not None or route_k is not None)
+                and self._n_pending + len(live) >= max(
+                    1, int(self._slo.degrade_queue_frac * self._depth))):
+            down = self._slo.degrade_k(route_k)
+            if down != route_k:
+                eff_k, degraded = down, True
+        return live, eff_k, degraded
+
+    def _hold_deadline(self, first: _Request) -> float:
+        """How long the worker may hold ``first`` to coalesce (lock held):
+        the configured window, shrunk so that (hold + a dispatch with
+        HEADROOM) still lands inside the request's deadline — adaptive
+        serve_max_wait under SLO pressure.  The reserve is 1.5x the EMA
+        (scheduling jitter margin), or half the request's remaining
+        budget before any dispatch has been measured — a reserve of
+        exactly the EMA (or zero) would hold a lone tight-deadline
+        request right up to its deadline and deterministically expire it
+        on an idle server."""
+        hold = first.t_submit + self._max_wait_s
+        if first.deadline is not None:
+            if self._ema_n:
+                reserve = 1.5 * self._ema_dispatch_s
+            else:
+                reserve = 0.5 * max(first.deadline - first.t_submit, 0.0)
+            hold = min(hold, first.deadline - reserve)
+        return hold
+
+    def _worker_loop(self, gen: int):
         big = self._buckets[-1]
+        try:
+            while True:
+                with self._work:
+                    while not self._n_pending and not self._closed \
+                            and gen == self._gen:
+                        self._work.wait()
+                    if gen != self._gen:
+                        return  # abandoned by the watchdog: a new worker owns the queue
+                    if not self._n_pending:
+                        return  # closed and drained
+                    # Fairness: serve the lane at the head of the round-robin
+                    # order; if it still has pending work afterwards it moves to
+                    # the back, so a flooding lane cannot starve the others.
+                    lane, q = next(iter(self._pending.items()))
+                    deadline = self._hold_deadline(q[0])
+                    while len(q) < big and not self._closed \
+                            and gen == self._gen:
+                        remaining = deadline - self._clock()
+                        if remaining <= 0:
+                            break
+                        self._work.wait(remaining)
+                    if gen != self._gen:
+                        return
+                    # Re-fetch the lane: the watchdog's expiry sweep /
+                    # quarantine drain may have emptied (or removed) it
+                    # while the wait above had the lock released.
+                    q = self._pending.get(lane)
+                    if not q:
+                        if q is not None:
+                            del self._pending[lane]
+                        continue
+                    # serve_max_wait_ms == 0 means coalescing is OFF: exactly one
+                    # request per dispatch (per-frame-call semantics), even when
+                    # a burst is already queued.
+                    take = 1 if self._max_wait_s == 0 else min(len(q), big)
+                    batch = [q.popleft() for _ in range(take)]
+                    self._n_pending -= take
+                    if q:
+                        self._pending.move_to_end(lane)
+                    else:
+                        del self._pending[lane]
+                    self._space.notify_all()
+                    batch, eff_k, degraded = self._prepare_batch(batch, lane)
+                    if batch:
+                        # Track the popped batch BEFORE the lock drops: in
+                        # the gap until _run re-registers it, these
+                        # requests are in neither _pending nor _inflight —
+                        # a worker death there would strand their callers
+                        # and the accounting would undercount pending.
+                        self._inflight = _Inflight(gen, lane, batch,
+                                                   self._clock())
+                if batch:
+                    self._run(batch, lane, eff_k, degraded, gen)
+        except BaseException as e:  # noqa: BLE001 — a dying worker must not strand callers
+            self._on_worker_death(gen, e)
+            raise
+
+    def _on_worker_death(self, gen, exc):
+        """The worker thread is dying with the queue: fail every pending
+        and in-flight request with a typed error and poison future
+        submissions — callers wake instead of stranding forever."""
+        with self._work:
+            if gen is not None and gen != self._gen:
+                return  # stale worker: the replacement owns the queue
+            self._worker_dead = f"worker thread died: {exc!r}"
+            err_reqs = []
+            if self._inflight is not None:
+                err_reqs += self._inflight.reqs
+                self._inflight = None
+            for q in self._pending.values():
+                err_reqs += list(q)
+            self._pending.clear()
+            self._n_pending = 0
+            for r in err_reqs:
+                self._finish(r, error=WorkerDiedError(self._worker_dead),
+                             outcome="failed")
+            self._work.notify_all()
+            self._space.notify_all()
+
+    def _run(self, reqs: list[_Request], lane, eff_k, degraded, gen):
+        """Execute one dispatch (worker thread or sync path), with SLO
+        retry/quarantine handling.  ``gen`` is the worker generation (None
+        on the sync path); a dispatch whose generation was abandoned by
+        the watchdog discards its late outcome entirely."""
+        scene, route_k = lane
+        attempt = 0
         while True:
             with self._work:
-                while not self._n_pending and not self._closed:
-                    self._work.wait()
-                if not self._n_pending:
-                    return  # closed and drained
-                # Fairness: serve the lane at the head of the round-robin
-                # order; if it still has pending work afterwards it moves to
-                # the back, so a flooding lane cannot starve the others.
-                lane, q = next(iter(self._pending.items()))
-                deadline = q[0].t_submit + self._max_wait_s
-                while len(q) < big and not self._closed:
-                    remaining = deadline - self._clock()
-                    if remaining <= 0:
-                        break
-                    self._work.wait(remaining)
-                # serve_max_wait_ms == 0 means coalescing is OFF: exactly one
-                # request per dispatch (per-frame-call semantics), even when
-                # a burst is already queued.
-                take = 1 if self._max_wait_s == 0 else min(len(q), big)
-                batch = [q.popleft() for _ in range(take)]
-                self._n_pending -= take
-                if q:
-                    self._pending.move_to_end(lane)
-                else:
-                    del self._pending[lane]
-                self._space.notify_all()
-            self._run(batch, *lane)
+                if gen is not None and gen != self._gen:
+                    return
+                infl = _Inflight(gen, lane, reqs, self._clock())
+                self._inflight = infl
+            try:
+                host, bucket, n_valid, t_done = self._dispatch(reqs, scene,
+                                                               eff_k)
+                import jax
 
-    def _run(self, reqs: list[_Request], scene=None, route_k=None):
-        try:
-            self._dispatch(reqs, scene, route_k)
-        except Exception as e:  # noqa: BLE001 — fan the failure out
-            for r in reqs:
-                r.error = e
-                r.event.set()
+                # Host-side result slicing: inside the try — a malformed
+                # result tree must fail THIS batch, never the worker — but
+                # OUTSIDE the lock: admission control's microsecond-
+                # rejection promise dies if submitters queue behind a
+                # full bucket's fan-out.
+                results = [
+                    jax.tree.map(lambda x, i=i: x[i], host)
+                    for i in range(len(reqs))
+                ]
+            except Exception as e:  # noqa: BLE001 — fan the failure out
+                attempt += 1
+                with self._work:
+                    stale = gen is not None and gen != self._gen
+                    retrying = (not stale and self._slo is not None
+                                and attempt <= self._slo.retry_max
+                                and not self._closed)
+                    if retrying:
+                        # Stay registered through the backoff (fresh age
+                        # clock): the accounting invariant — outcomes +
+                        # pending == offered — must hold at EVERY instant,
+                        # and an unregistered in-flight batch would drop
+                        # out of ``pending`` for the sleep window.
+                        self._inflight = _Inflight(gen, lane, reqs,
+                                                   self._clock())
+                    elif not stale:
+                        self._inflight = None
+                        for r in reqs:
+                            self._finish(r, error=e, outcome="failed")
+                        if self._slo is not None:
+                            self._fail_streak[lane] += 1
+                            if self._fail_streak[lane] >= \
+                                    self._slo.quarantine_after:
+                                self._quarantined[lane] = (
+                                    f"{self._fail_streak[lane]} consecutive "
+                                    f"dispatch failures (last: {e!r})"
+                                )
+                                self._drain_lane(
+                                    lane,
+                                    lambda: LaneQuarantinedError(
+                                        f"lane {lane} quarantined after "
+                                        "repeated dispatch failures"
+                                    ),
+                                    "shed",
+                                )
+                if not retrying:
+                    return
+                time.sleep(self._slo.backoff_s(attempt))
+                continue
+            with self._work:
+                if gen is not None and gen != self._gen:
+                    return  # abandoned mid-dispatch: requests already failed
+                self._inflight = None
+                self._fail_streak[lane] = 0
+                dt = t_done - infl.t_start
+                self._ema_dispatch_s = (
+                    dt if self._ema_n == 0
+                    else 0.25 * dt + 0.75 * self._ema_dispatch_s
+                )
+                self._ema_n += 1
+                self._record(bucket, n_valid, scene, route_k,
+                             [t_done - r.t_submit for r in reqs])
+                outcome = "degraded" if degraded else "served"
+                for r, res in zip(reqs, results):
+                    if r.deadline is not None and t_done > r.deadline:
+                        # Landed past the deadline: the SLO contract says
+                        # this is not a serve — discard, count expired.
+                        self._finish(
+                            r,
+                            error=DeadlineExceededError(
+                                f"result landed "
+                                f"{(t_done - r.deadline) * 1e3:.1f}ms past "
+                                "the deadline"
+                            ),
+                            outcome="expired",
+                        )
+                    else:
+                        self._finish(r, result=res, outcome=outcome,
+                                     eff_k=eff_k)
+            return
 
-    def _dispatch(self, reqs: list[_Request], scene=None, route_k=None):
+    def _dispatch(self, reqs: list[_Request], scene, route_k):
+        """Pad, stage and execute one dispatch; returns the host-side
+        result tree + timing.  No dispatcher state is touched here — the
+        caller owns locking and fan-out."""
         import jax
         import numpy as np
 
@@ -300,12 +806,96 @@ class MicroBatchDispatcher:
         out = jax.block_until_ready(out)
         t_done = self._clock()
         host = jax.tree.map(np.asarray, out)
-        with self._lock:
-            self._record(bucket, n_valid, scene, route_k,
-                         [t_done - r.t_submit for r in reqs])
-        for i, r in enumerate(reqs):
-            r.result = jax.tree.map(lambda x: x[i], host)
-            r.event.set()
+        return host, bucket, n_valid, t_done
+
+    # ---------------- watchdog ----------------
+
+    def _watchdog_loop(self):
+        poll = self._slo.watchdog_poll_ms / 1e3
+        limit = self._slo.watchdog_ms / 1e3
+        while True:
+            with self._work:
+                if self._closed and self._inflight is None \
+                        and not self._n_pending:
+                    return
+                now = self._clock()
+                self._expire_queued(now)
+                infl = self._inflight
+                if infl is not None and now - infl.t_start > limit:
+                    self._abandon_inflight(infl, now)
+            time.sleep(poll)
+
+    def _expire_queued(self, now):
+        """Fail queued requests past their deadline (lock held) — the
+        sweep that bounds waiting even while the worker is busy or
+        wedged on another lane."""
+        drop = []
+        removed = 0
+        for lane, q in self._pending.items():
+            kept = []
+            for r in q:
+                if r.done:
+                    self._n_pending -= 1
+                    removed += 1
+                elif r.deadline is not None and now > r.deadline:
+                    self._finish(
+                        r,
+                        error=DeadlineExceededError(
+                            f"expired in queue after "
+                            f"{(now - r.t_submit) * 1e3:.1f}ms"
+                        ),
+                        outcome="expired",
+                    )
+                    self._n_pending -= 1
+                    removed += 1
+                else:
+                    kept.append(r)
+            if len(kept) != len(q):
+                # Mutate IN PLACE: the worker may hold a reference to this
+                # deque across a lock-released coalescing wait — swapping
+                # the object under it would desync the pending count.
+                q.clear()
+                q.extend(kept)
+            if not q:
+                drop.append(lane)
+        for lane in drop:
+            del self._pending[lane]
+        if removed:
+            self._space.notify_all()
+
+    def _abandon_inflight(self, infl: _Inflight, now):
+        """Declare the in-flight dispatch wedged (lock held): fail its
+        requests with a precise typed error INSIDE their deadline,
+        quarantine the lane, abandon the stuck worker's generation and
+        hand the healthy lanes to a replacement worker.  The stuck thread
+        is never killed (CLAUDE.md: killing a process awaiting the relay
+        wedges it permanently); when — if — it unsticks, its stale
+        generation discards everything."""
+        age_ms = (now - infl.t_start) * 1e3
+        err = DispatchStalledError(
+            f"dispatch on lane {infl.lane} made no progress for "
+            f"{age_ms:.0f}ms (watchdog_ms={self._slo.watchdog_ms}); lane "
+            "quarantined"
+        )
+        for r in infl.reqs:
+            self._finish(r, error=err, outcome="failed")
+        self._quarantined[infl.lane] = f"wedged dispatch ({age_ms:.0f}ms)"
+        self._inflight = None
+        # The quarantined lane's backlog must not re-wedge the replacement.
+        self._drain_lane(
+            infl.lane,
+            lambda: LaneQuarantinedError(
+                f"lane {infl.lane} quarantined (wedged dispatch)"
+            ),
+            "shed",
+        )
+        if infl.gen is not None and infl.gen == self._gen:
+            self._gen += 1
+            if not self._closed or self._n_pending:
+                self._worker = self._spawn_worker()
+            else:
+                self._worker = None  # nothing left to drain: close() can stop joining the wedged thread
+            self._work.notify_all()
 
     # ---------------- stats / lifecycle ----------------
 
@@ -325,13 +915,66 @@ class MicroBatchDispatcher:
         with self._lock:
             return dict(self.dispatch_counts)
 
+    def slo_totals(self) -> dict:
+        """Locked snapshot of the outcome accounting: ``offered``, one
+        count per outcome class, and what is still in flight/queued.  The
+        invariant — served + shed + expired + degraded + failed + pending
+        == offered — is pinned by tests/test_serve_slo.py.  (A request
+        abandoned by its caller stays physically queued until the next
+        watchdog sweep; those are already counted in their outcome class,
+        so only unresolved requests count as pending here.)"""
+        with self._lock:
+            return {
+                "offered": self.offered,
+                "served": self.outcome_counts["served"],
+                "shed": self.outcome_counts["shed"],
+                "expired": self.outcome_counts["expired"],
+                "degraded": self.outcome_counts["degraded"],
+                "failed": self.outcome_counts["failed"],
+                "pending": self._unresolved_count(),
+            }
+
+    def _unresolved_count(self) -> int:
+        """Requests not yet in any outcome class (lock held): queued ones
+        that are still live plus the not-yet-done in-flight batch.  BOTH
+        ``slo_totals``'s pending and ``reset_stats``'s offered re-base
+        depend on this exact computation — one definition, one truth."""
+        infl = (sum(1 for r in self._inflight.reqs if not r.done)
+                if self._inflight else 0)
+        queued_done = sum(
+            sum(1 for r in q if r.done) for q in self._pending.values()
+        )
+        return self._n_pending - queued_done + infl
+
+    def quarantined_lanes(self) -> dict:
+        """Locked snapshot: lane -> quarantine reason."""
+        with self._lock:
+            return dict(self._quarantined)
+
+    def release_lane(self, scene=None, route_k=None) -> None:
+        """Operator action: clear a lane's quarantine + failure streak
+        after the underlying fault (relay recovery, fixed weights) is
+        resolved.  New submissions to the lane are admitted again."""
+        lane = (scene, route_k)
+        with self._work:
+            self._quarantined.pop(lane, None)
+            self._fail_streak.pop(lane, None)
+
     def reset_stats(self):
+        """Clear the stat rings and outcome accounting.  ``offered`` is
+        re-based to the requests still unresolved at reset time — they
+        will land in the (now zeroed) outcome counts later, and a reset
+        that set offered to 0 would break the accounting invariant
+        forever on a busy server."""
         with self._lock:
             self.latencies_s.clear()
             self.dispatch_log.clear()
             self.scene_log.clear()
             self.route_log.clear()
             self.dispatch_counts.clear()
+            self.outcome_counts.clear()
+            self.outcome_log.clear()
+            self.offered = self._unresolved_count()
 
     def cache_size(self) -> int | None:
         """Compiled-program count of the jitted entry point (None when the
@@ -340,13 +983,52 @@ class MicroBatchDispatcher:
         return probe() if callable(probe) else None
 
     def close(self):
-        """Drain the queue, stop the worker, reject new submissions."""
+        """Drain the queue, stop the worker, reject new submissions.
+        Anything a (dead, wedged, or never-started) worker cannot drain is
+        failed with a typed error — close() never strands a caller."""
         with self._work:
             self._closed = True
             self._work.notify_all()
             self._space.notify_all()
-        if self._worker is not None:
-            self._worker.join()
+        # Let the live worker drain.  Bounded join slices: if the watchdog
+        # replaces a wedged worker mid-close, switch to joining the
+        # replacement (the stuck daemon thread is abandoned, never killed).
+        while True:
+            with self._work:
+                worker = self._worker
+            if worker is None or worker is threading.current_thread() \
+                    or not worker.is_alive():
+                break
+            worker.join(0.2)
+            with self._work:
+                replaced = self._worker is not worker
+            if not replaced and not worker.is_alive():
+                break
+            if not replaced and self._slo is None:
+                worker.join()  # legacy mode: drain however long it takes
+                break
+        # Fail whatever could not drain (no worker ever started, worker
+        # dead, quarantined lanes) so every waiter wakes.
+        with self._work:
+            leftovers = []
+            if self._inflight is not None:
+                leftovers += self._inflight.reqs
+                self._inflight = None
+            for q in self._pending.values():
+                leftovers += [r for r in q if not r.done]
+            self._pending.clear()
+            self._n_pending = 0
+            for r in leftovers:
+                self._finish(
+                    r,
+                    error=DispatcherClosedError(
+                        "dispatcher closed with the request still pending"
+                    ),
+                    outcome="failed",
+                )
+            watchdog = self._watchdog
+        if watchdog is not None and watchdog is not threading.current_thread():
+            watchdog.join()
 
     def __enter__(self):
         return self

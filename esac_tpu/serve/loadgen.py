@@ -1,0 +1,162 @@
+"""Open-loop load generation over the micro-batching dispatcher.
+
+Every serve number before DESIGN.md §12 was CLOSED-loop: one dispatch
+timed at a time, the next request waiting for the last answer.  Real
+traffic does not wait — requests arrive on the *arrival process's* clock,
+pile up when the server falls behind, and the interesting numbers
+(sustained hyps/s, p50/p99 vs offered load, where the knee is) only exist
+under that regime.  This module is the open-loop driver:
+
+- :func:`poisson_arrivals` / :func:`uniform_arrivals` build an arrival
+  schedule (cumulative seconds) for a target offered rate — Poisson for
+  memoryless traffic, uniform for a deterministic trace.
+- :func:`run_open_loop` replays a schedule against a dispatcher:
+  ``submit`` fires at each arrival time regardless of completions (the
+  open-loop property — admission control, not caller blocking, is what
+  bounds the queue, so the dispatcher should carry an
+  :class:`~esac_tpu.serve.slo.SLOPolicy`), outcomes are collected from
+  the requests themselves, and the summary reports achieved offered
+  rate, the outcome accounting (which must sum to offered — the
+  tests/test_serve_slo.py invariant), served-latency quantiles and
+  sustained throughput.
+
+Pure host code: no jax, no jitted surfaces (nothing here is an R11
+entry point).  Requests can mix scenes, ``route_k`` values and frame
+shapes — lanes are the dispatcher's problem — via the ``make_request``
+callback, which maps an arrival index to ``(frame, scene, route_k)``.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from esac_tpu.serve.slo import DeadlineExceededError, ShedError
+
+# Outcome classes a request can end in (the accounting invariant's terms).
+OUTCOMES = ("served", "degraded", "shed", "expired", "failed")
+
+
+def poisson_arrivals(rate_rps: float, n: int, seed: int = 0) -> np.ndarray:
+    """Cumulative arrival times (seconds) of ``n`` Poisson arrivals at
+    ``rate_rps``: i.i.d. exponential gaps, deterministic per seed."""
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps {rate_rps} <= 0")
+    gaps = np.random.RandomState(seed).exponential(1.0 / rate_rps, size=n)
+    return np.cumsum(gaps)
+
+
+def uniform_arrivals(rate_rps: float, n: int) -> np.ndarray:
+    """Cumulative arrival times of a deterministic constant-rate trace."""
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps {rate_rps} <= 0")
+    return (np.arange(n, dtype=np.float64) + 1.0) / rate_rps
+
+
+def run_open_loop(
+    disp,
+    make_request,
+    arrivals,
+    deadline_ms: float | None = None,
+    hyps_per_request: int = 1,
+    settle_s: float = 30.0,
+) -> dict:
+    """Replay an open-loop arrival schedule against ``disp``.
+
+    ``make_request(i) -> (frame, scene, route_k)`` builds request ``i``;
+    ``arrivals`` is the cumulative schedule (seconds from start).  Submits
+    never block on completions: a shed (typed
+    :class:`~esac_tpu.serve.slo.ShedError`) is recorded and the generator
+    moves on — exactly the admission-control contract.  After the last
+    arrival, every admitted request is awaited for its remaining deadline
+    plus ``settle_s`` grace (the dispatcher wakes waiters on watchdog
+    abandonment, worker death and close, so the grace is slack for
+    scheduling, not a correctness crutch).
+
+    Returns a summary dict: achieved offered rate, per-outcome counts
+    (summing to ``offered``), served+degraded latency quantiles,
+    sustained goodput in requests/s and hyps/s over the span from first
+    arrival to last completion, and the raw per-request outcome list.
+    """
+    arrivals = np.asarray(arrivals, np.float64)
+    n = len(arrivals)
+    if n == 0:
+        raise ValueError("empty arrival schedule")
+    admitted = []          # (index, request)
+    outcomes = [None] * n  # per-request outcome string
+    # Pacing runs on the harness clock; every latency/deadline quantity
+    # below comes from the REQUESTS' own timestamps (the dispatcher's
+    # clock domain) — mixing the two would corrupt wait budgets the
+    # moment either side used a non-default clock.
+    t0 = time.perf_counter()
+    for i in range(n):
+        target = t0 + float(arrivals[i])
+        while True:
+            # Sleep-until with a cap so coarse schedulers cannot overshoot
+            # a whole burst of arrivals.
+            now = time.perf_counter()
+            if now >= target:
+                break
+            time.sleep(min(target - now, 0.01))
+        frame, scene, route_k = make_request(i)
+        try:
+            req = disp.submit(frame, scene=scene, route_k=route_k,
+                              deadline_ms=deadline_ms)
+        except ShedError:
+            outcomes[i] = "shed"
+            continue
+        except DeadlineExceededError:
+            # A no-SLO dispatcher's bounded space wait expires instead of
+            # shedding; the request's fate is recorded, never a harness
+            # crash that loses the whole point's outcomes.
+            outcomes[i] = "expired"
+            continue
+        admitted.append((i, req, time.perf_counter()))
+    t_last_arrival = time.perf_counter()
+
+    latencies = []
+    t_end = t_last_arrival
+    for i, req, t_sub_h in admitted:
+        # The request's FULL deadline window (its own clock domain) plus
+        # grace; the event is guaranteed to fire eventually, the bound
+        # keeps a broken dispatcher from hanging the harness.
+        budget = settle_s
+        if req.deadline is not None:
+            budget += max(0.0, req.deadline - req.t_submit)
+        if not req.event.wait(budget):
+            outcomes[i] = "lost"  # should be impossible; surfaced, not hidden
+            continue
+        outcomes[i] = req.outcome
+        if req.outcome in ("served", "degraded"):
+            # Latency in the dispatcher's clock domain; the completion
+            # instant anchored on the ACTUAL submit time (a generator
+            # running behind schedule must not shrink the span and
+            # inflate sustained throughput).
+            latencies.append(req.t_done - req.t_submit)
+            t_end = max(t_end, t_sub_h + (req.t_done - req.t_submit))
+
+    counts = {o: outcomes.count(o) for o in OUTCOMES}
+    counts["lost"] = outcomes.count("lost")
+    good = counts["served"] + counts["degraded"]
+    span = max(t_end - t0, 1e-9)
+    lat = np.sort(np.asarray(latencies)) if latencies else None
+
+    def q(p):
+        if lat is None:
+            return float("nan")
+        return float(lat[min(len(lat) - 1, round(p * (len(lat) - 1)))])
+
+    return {
+        "offered": n,
+        "offered_rps_target": round(n / float(arrivals[-1]), 2),
+        "offered_rps_achieved": round(n / max(t_last_arrival - t0, 1e-9), 2),
+        "outcomes": counts,
+        "goodput_ratio": round(good / n, 4),
+        "served_rps": round(good / span, 2),
+        "sustained_hyps_per_s": round(good * hyps_per_request / span, 1),
+        "p50_ms": round(q(0.5) * 1e3, 2),
+        "p99_ms": round(q(0.99) * 1e3, 2),
+        "span_s": round(span, 3),
+        "per_request_outcomes": outcomes,
+    }

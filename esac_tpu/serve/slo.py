@@ -1,0 +1,230 @@
+"""SLO machinery for the serving stack: typed outcomes, policy, faults.
+
+Everything the dispatcher needed to go from *benchmarked* to *operable*
+under open-loop load (DESIGN.md §12).  Three pieces live here:
+
+- **Typed request outcomes** (:class:`ServeError` tree): a caller of
+  ``infer_one``/``submit`` can catch exactly the failure class it can act
+  on — :class:`ShedError` (admission control said no; retry elsewhere),
+  :class:`DeadlineExceededError` (the request expired; the answer is
+  worthless now), :class:`DispatchStalledError` (the dispatch wedged and
+  the watchdog failed it — the environment's observed relay-stall mode,
+  CLAUDE.md hazards), :class:`LaneQuarantinedError` (the lane is known
+  bad until an operator releases it), :class:`WorkerDiedError` /
+  :class:`DispatcherClosedError` (the server is gone; nothing queued will
+  ever run).
+
+- **:class:`SLOPolicy`**: one frozen host-side knob set handed to the
+  dispatcher.  It deliberately does NOT ride
+  :class:`~esac_tpu.ransac.config.RansacConfig` — every field there is a
+  static jit argument and participates in the compiled-program hash,
+  while SLO knobs are pure host scheduling state that must be tunable on
+  a live server without touching the jit cache.
+
+- **:class:`FaultInjector`**: the injectable stall/failure shim on the
+  dispatch path.  The relay stall this repo has actually observed (a
+  trainer frozen mid-run, socket ESTAB, request outstanding forever) is
+  indistinguishable from an ``infer_fn`` call that never returns, so the
+  shim simulates exactly that: it wraps the dispatcher's ``infer_fn`` and
+  can be armed to block on an Event (a stall the test releases later) or
+  to raise (a transient failure) on the Nth dispatch.  Tests drive it;
+  the watchdog in ``serve.dispatcher`` is what production relies on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+
+class ServeError(RuntimeError):
+    """Base class of every typed serving failure."""
+
+
+class ShedError(ServeError):
+    """Admission control rejected the request before it entered the queue
+    (bounded queue full, or predicted wait exceeds the request's SLO)."""
+
+
+class LaneQuarantinedError(ShedError):
+    """The request's (scene, route_k) lane is quarantined after a wedged or
+    repeatedly failing dispatch; an operator must ``release_lane`` it.
+    A quarantine rejection is a shed (it happens at admission), so callers
+    that only distinguish *admitted vs not* can catch :class:`ShedError`."""
+
+
+class DeadlineExceededError(ServeError):
+    """The request missed its deadline — expired in the queue, or the
+    caller's wait timed out before a result landed."""
+
+
+class DispatchStalledError(ServeError):
+    """The watchdog declared the in-flight dispatch wedged (no progress
+    within ``SLOPolicy.watchdog_ms``) and failed its requests rather than
+    letting callers hang — the relay-stall failure mode made a bounded,
+    typed error."""
+
+
+class WorkerDiedError(ServeError):
+    """The dispatcher's worker thread died with requests pending; nothing
+    queued will ever dispatch.  Pending and future requests fail with
+    this instead of stranding their callers forever."""
+
+
+class DispatcherClosedError(ServeError):
+    """``close()`` ran while requests were still pending and no worker
+    could drain them."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOPolicy:
+    """Host-side serving SLO knobs (see module docstring for why these do
+    not live on RansacConfig).  Passing a policy to the dispatcher opts
+    the request path into deadlines, admission control, degradation and
+    the watchdog; without one the PR-2 contract (block-for-space, wait
+    forever) is preserved byte-for-byte."""
+
+    # Default per-request deadline, milliseconds; None = no deadline (the
+    # other SLO machinery — shed-on-full, watchdog, degradation — still
+    # applies).  ``submit``/``infer_one`` may override per request.
+    deadline_ms: float | None = None
+    # Admission control: with a bounded queue at capacity the dispatcher
+    # SHEDS (typed ShedError) instead of blocking the submitter — open-loop
+    # traffic must never convert overload into unbounded caller threads.
+    # Additionally, a request whose PREDICTED wait (dispatch-time EMA x
+    # queue occupancy ahead of it) already exceeds its deadline is shed at
+    # submit time: rejecting in microseconds beats serving a corpse late.
+    shed_on_predicted_miss: bool = True
+    # Graceful degradation: when queue occupancy (pending / depth) reaches
+    # this fraction, a lane's dispatches downshift ``route_k`` one rung
+    # down ``degrade_route_k`` (ascending K ladder).  PR 4 made "cheaper"
+    # a STATIC program we already compile — K is a static argument of the
+    # routed bucket programs — so degrading swaps to an
+    # already-compiled-family program and never recompiles (pinned in
+    # tests/test_serve_slo.py).  Empty ladder = degradation off.
+    degrade_queue_frac: float = 0.75
+    degrade_route_k: tuple[int, ...] = ()
+    # Watchdog: an in-flight dispatch older than this is declared wedged —
+    # its requests fail with DispatchStalledError, the lane is
+    # quarantined, and a replacement worker takes over the other lanes.
+    # Size it to a few healthy dispatch times and BELOW deadline_ms, so
+    # the watchdog (not the caller's own timeout) is what fires first.
+    # PREWARM before serving (SceneRegistry.prewarm_programs, or drive
+    # each program once through a worker-less dispatcher): a first-compile
+    # dispatch takes seconds and is indistinguishable from a stall, so a
+    # cold program under a tight watchdog gets its lane quarantined at
+    # the first request — typed and bounded, but not what you wanted.
+    watchdog_ms: float = 1_000.0
+    # Watchdog poll interval (also bounds how stale queue-expiry is).
+    watchdog_poll_ms: float = 20.0
+    # Transient-failure retries per dispatch (an infer_fn that RAISES, as
+    # opposed to one that hangs), with capped exponential backoff.
+    retry_max: int = 1
+    retry_backoff_ms: float = 10.0
+    retry_backoff_max_ms: float = 200.0
+    # Consecutive exhausted-retry dispatch failures on one lane before the
+    # lane is quarantined (a wedged dispatch quarantines immediately).
+    quarantine_after: int = 2
+
+    def __post_init__(self):
+        if self.deadline_ms is not None and self.deadline_ms <= 0:
+            raise ValueError(f"deadline_ms {self.deadline_ms} <= 0")
+        if not 0.0 < self.degrade_queue_frac <= 1.0:
+            raise ValueError(
+                f"degrade_queue_frac {self.degrade_queue_frac} outside (0, 1]"
+            )
+        if any(k < 1 for k in self.degrade_route_k):
+            raise ValueError(
+                f"degrade_route_k {self.degrade_route_k} has entries < 1"
+            )
+        if self.watchdog_ms <= 0 or self.watchdog_poll_ms <= 0:
+            raise ValueError("watchdog_ms / watchdog_poll_ms must be > 0")
+        if self.retry_max < 0 or self.quarantine_after < 1:
+            raise ValueError("retry_max >= 0 and quarantine_after >= 1")
+
+    def degrade_k(self, route_k: int | None) -> int | None:
+        """The next-cheaper rung for a lane at ``route_k``: dense (None)
+        downshifts to the ladder's LARGEST K (nearest-quality cheaper
+        program); routed K to the largest rung strictly below K; already
+        at/below the bottom rung stays put.  One rung per dispatch — the
+        degradation is gradual, not a cliff."""
+        ladder = sorted(set(self.degrade_route_k))
+        if not ladder:
+            return route_k
+        if route_k is None:
+            return ladder[-1]
+        below = [k for k in ladder if k < route_k]
+        return below[-1] if below else route_k
+
+    def backoff_s(self, attempt: int) -> float:
+        """Capped exponential backoff before retry ``attempt`` (1-based)."""
+        return min(
+            self.retry_backoff_ms * (2 ** (attempt - 1)),
+            self.retry_backoff_max_ms,
+        ) / 1e3
+
+
+class FaultInjector:
+    """Stall/failure shim wrapping a dispatcher ``infer_fn``.
+
+    Arm with :meth:`stall_once` (the Nth call blocks on an Event until the
+    test releases it — byte-for-byte the observed relay stall from the
+    worker thread's point of view) or :meth:`fail_times` (the next calls
+    raise).  Unarmed calls pass straight through.  All mutable state is
+    guarded by the instance lock (graft-lint R10 applies to this module);
+    the stall wait itself happens OUTSIDE the lock so stats stay readable
+    while a dispatch is wedged.
+    """
+
+    def __init__(self, infer_fn):
+        self._infer = infer_fn
+        self._cache_size = getattr(infer_fn, "_cache_size", None)
+        self._lock = threading.Lock()
+        self._stall_release: threading.Event | None = None
+        self._stall_after = 0
+        self._fail_exc: Exception | None = None
+        self._fail_left = 0
+        self._calls = 0
+        self._stalls = 0
+        self._failures = 0
+
+    def stall_once(self, release: threading.Event, after: int = 0) -> None:
+        """Arm ONE stall: the ``after``-th call from now blocks on
+        ``release`` (0 = the very next call)."""
+        with self._lock:
+            self._stall_release = release
+            self._stall_after = after
+
+    def fail_times(self, exc: Exception, times: int = 1) -> None:
+        """Arm ``times`` consecutive failures raising ``exc``."""
+        with self._lock:
+            self._fail_exc = exc
+            self._fail_left = times
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "calls": self._calls,
+                "stalls": self._stalls,
+                "failures": self._failures,
+            }
+
+    def __call__(self, tree, *rest):
+        release = None
+        with self._lock:
+            self._calls += 1
+            if self._stall_release is not None:
+                if self._stall_after <= 0:
+                    release = self._stall_release
+                    self._stall_release = None
+                    self._stalls += 1
+                else:
+                    self._stall_after -= 1
+            if release is None and self._fail_left > 0:
+                self._fail_left -= 1
+                self._failures += 1
+                exc = self._fail_exc
+                raise exc
+        if release is not None:
+            release.wait()  # the wedge: held until the test releases it
+        return self._infer(tree, *rest)

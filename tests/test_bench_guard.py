@@ -374,3 +374,140 @@ def test_breadcrumb_live_owner_left_alone(tmp_path, monkeypatch):
     crumb.write_text("owner=1 12345\n")
     bench._resume_stale_breadcrumb()
     assert sent == [] and crumb.exists()
+
+
+# ---------------- loadtest driver contract (ISSUE 7) ----------------
+
+def _canned_loadtest():
+    """Minimal-but-complete loadtest payload: the schema the driver and
+    the committed artifact rely on."""
+    def point(mult, served, shed):
+        n = served + shed
+        return {
+            "offered_x_capacity": mult,
+            "offered_rps": 100.0 * mult,
+            "offered": n,
+            "offered_rps_target": 100.0 * mult,
+            "offered_rps_achieved": 99.0 * mult,
+            "outcomes": {"served": served, "degraded": 0, "shed": shed,
+                         "expired": 0, "failed": 0, "lost": 0},
+            "goodput_ratio": served / n,
+            "served_rps": 90.0,
+            "sustained_hyps_per_s": 1440.0,
+            "p50_ms": 5.0,
+            "p99_ms": 12.0,
+            "span_s": 1.0,
+        }
+
+    def leg(program, route_k, bucket, knee):
+        return {
+            "program": program, "route_k": route_k, "frame_bucket": bucket,
+            "closed_loop_dispatch_ms": 2.0,
+            "closed_loop_capacity_rps": 100.0,
+            "deadline_ms": 300.0, "compiled_programs": 1,
+            "points": [point(0.5, 50, 0), point(2.0, 60, 40)],
+            "knee_offered_rps": 50.0 if knee else None,
+            "knee_sustained_hyps_per_s": knee,
+        }
+
+    return {
+        "num_experts": 4, "hw": [24, 24], "hyps_per_request": 16,
+        "offered_mults": [0.5, 2.0], "open_loop_seconds_per_point": 2.5,
+        "legs": [
+            leg("dense", None, 2, 800.0),
+            leg("dense", None, 8, 1440.0),
+            leg("routed_k2", 2, 2, 700.0),
+            leg("routed_k2", 2, 8, 1200.0),
+        ],
+        "note": "canned",
+    }
+
+
+def test_loadtest_main_emits_one_json_line_and_artifact(tmp_path, monkeypatch, capsys):
+    """The driver contract: ONE parseable JSON line on stdout, the
+    headline value from the dense/largest-bucket leg's knee, and the
+    .serve_loadtest.json artifact with platform + recorded_at."""
+    monkeypatch.setattr(bench, "_LOADTEST_FILE", tmp_path / "loadtest.json")
+    monkeypatch.setattr(
+        bench, "measure_on_device",
+        lambda *a, **k: {"loadtest": _canned_loadtest(), "platform": "tpu",
+                         "device_kind": "fake-tpu"},
+    )
+    bench._loadtest_main([], [0.0, 0.0, 0.0])
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1, f"expected ONE JSON line, got {len(lines)}"
+    out = json.loads(lines[0])
+    assert out["metric"] == "serve_loadtest_knee_sustained_hyps_per_s"
+    assert out["value"] == 1440.0  # dense, frame_bucket 8
+    assert out["unit"] == "hyps/s"
+    assert "vs_baseline" in out
+    assert out["device_kind"] == "fake-tpu"
+    assert out["knee_offered_rps_dense_big_bucket"] == 50.0
+    assert "contention" in out
+    artifact = json.loads((tmp_path / "loadtest.json").read_text())
+    assert artifact["platform"] == "tpu"
+    assert "recorded_at" in artifact
+    assert len(artifact["loadtest"]["legs"]) == 4
+
+
+def test_loadtest_cpu_fallback_carries_provenance(tmp_path, monkeypatch, capsys):
+    """Relay wedged -> the sweep measures on CPU and SAYS so: note field
+    on the JSON line, platform "cpu" in the artifact."""
+    monkeypatch.setattr(bench, "_LOADTEST_FILE", tmp_path / "loadtest.json")
+    monkeypatch.setattr(bench, "measure_on_device", lambda *a, **k: None)
+    monkeypatch.setattr(bench, "_measure_loadtest",
+                        lambda *a, **k: _canned_loadtest())
+    bench._loadtest_main([], [0.0, 0.0, 0.0])
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1
+    out = json.loads(lines[0])
+    assert "CPU" in out["note"] or "cpu" in out["note"]
+    artifact = json.loads((tmp_path / "loadtest.json").read_text())
+    assert artifact["platform"] == "cpu"
+    assert artifact["note"] == out["note"]
+
+
+def test_loadtest_artifact_schema_outcome_accounting():
+    """The committed .serve_loadtest.json (when present) satisfies the
+    schema the driver consumes — per-point outcome accounting sums to
+    offered, every leg locates (or honestly nulls) its knee."""
+    import pathlib
+
+    path = pathlib.Path(bench.__file__).parent / ".serve_loadtest.json"
+    if not path.exists():
+        import pytest
+
+        pytest.skip("no committed loadtest artifact yet")
+    artifact = json.loads(path.read_text())
+    for key in ("metric", "value", "unit", "platform", "recorded_at",
+                "loadtest"):
+        assert key in artifact, key
+    legs = artifact["loadtest"]["legs"]
+    assert {(l["program"], l["frame_bucket"]) for l in legs} >= {
+        ("dense", 2), ("dense", 8), ("routed_k2", 2), ("routed_k2", 8),
+    }
+    for leg in legs:
+        assert leg["compiled_programs"] == 1  # one program per (K, bucket)
+        for p in leg["points"]:
+            o = p["outcomes"]
+            total = sum(o[k] for k in
+                        ("served", "degraded", "shed", "expired", "failed",
+                         "lost"))
+            assert total == p["offered"], (leg["program"], p)
+
+
+def test_loadtest_knee_is_longest_passing_prefix():
+    """A noisy non-monotone sweep must not report a knee ABOVE a load the
+    server already failed: the knee is the last point of the longest
+    goodput>=0.99 prefix, not the max passing point."""
+    def pt(mult, good):
+        return {"offered_x_capacity": mult, "offered_rps": 100.0 * mult,
+                "goodput_ratio": good}
+
+    assert bench._loadtest_knee([])is None
+    assert bench._loadtest_knee([pt(0.4, 0.9)]) is None
+    monotone = [pt(0.4, 1.0), pt(0.8, 1.0), pt(1.2, 0.85), pt(2.0, 0.6)]
+    assert bench._loadtest_knee(monotone)["offered_x_capacity"] == 0.8
+    # Non-monotone: 0.8 failed, 1.2 "passed" by luck -> knee stays at 0.4.
+    noisy = [pt(0.4, 1.0), pt(0.8, 0.958), pt(1.2, 1.0), pt(2.0, 0.6)]
+    assert bench._loadtest_knee(noisy)["offered_x_capacity"] == 0.4

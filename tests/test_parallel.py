@@ -529,14 +529,45 @@ def test_routed_training_matches_dense_when_capacity_covers_mass():
     # (observed cross-program deviation 4e-5 relative).  A real routing
     # divergence (wrong expert, wrong key) shifts the loss by O(10%).
     np.testing.assert_allclose(routed_val, dense_val, rtol=5e-4)
-    # Same math, different XLA programs (dense vmaps all M experts; routed
-    # computes the selected subset), so f32 reduction order differs: compare
-    # with an atol scaled to the gradient magnitude, not machine epsilon.
-    for r_g, d_g in zip(routed_grads, dense_grads):
-        scale = float(np.max(np.abs(np.asarray(d_g)))) or 1.0
-        np.testing.assert_allclose(
-            r_g, d_g, rtol=1e-3, atol=1e-3 * scale
-        )
+    # Gating gradients: tiny and smooth (softmax of the mass) — strict
+    # scale-aware allclose holds with margin (measured l2rel ~1e-5).
+    g_scale = float(np.max(np.abs(np.asarray(dense_grads[1])))) or 1.0
+    np.testing.assert_allclose(
+        routed_grads[1], dense_grads[1], rtol=1e-3, atol=1e-3 * g_scale
+    )
+    # Expert-map gradients: DISPOSITIONED criterion (PR 7, the PR-3
+    # scale-aware pattern).  An element-wise allclose at (rtol 1e-3,
+    # atol 1e-3*scale) fails on a handful of cells — measured 2026-08-04
+    # on this container: 5/7200 elements, max |diff| 77 on a scale-2253
+    # gradient, confined to cells (expert 5, cell 297) and (expert 6,
+    # cell 280).  Root cause is cross-program f32 BRANCH chaos, not a
+    # routing divergence: a capacity=2 CONTROL (capacity covers ALL local
+    # experts, so the routed program computes the identical selected set
+    # as dense and no routing/selection semantics differ) reproduces the
+    # same signature at the SAME cells (6/7200, max |diff| 58) — with
+    # unclamped ~1e3 per-hypothesis losses, autodiff-through-IRLS sits on
+    # hypothesis-selection / P3P-root branch boundaries where the ~1e-5
+    # forward jitter between differently-fused XLA programs flips a
+    # branch, swinging those cells' VJP contributions entirely while the
+    # loss itself moves ~5e-7 relative (near-equal branches).  A real
+    # routing bug (wrong expert, wrong RNG key) would corrupt whole
+    # (frame, expert) gradient MAPS, not isolated cells.  Criterion:
+    # aggregate relative L2 error <= 5% (measured 1.5-1.9% for BOTH the
+    # capacity=1 leg and the control) and branch-flip cells budgeted at
+    # <= 0.5% of elements (measured 0.07-0.08%), plus the exact
+    # zero-structure assertions below, which a routing divergence cannot
+    # survive.
+    r_e = np.asarray(routed_grads[0])
+    d_e = np.asarray(dense_grads[0])
+    e_scale = float(np.max(np.abs(d_e))) or 1.0
+    l2rel = np.linalg.norm(r_e - d_e) / max(np.linalg.norm(d_e), 1e-12)
+    assert l2rel <= 0.05, f"aggregate gradient L2 error {l2rel:.3e} > 5%"
+    viol = np.abs(r_e - d_e) > (1e-3 * e_scale + 1e-3 * np.abs(d_e))
+    viol_frac = viol.mean()
+    assert viol_frac <= 0.005, (
+        f"{int(viol.sum())}/{viol.size} elements outside the f32 envelope "
+        f"({viol_frac:.2%} > 0.5% branch-flip budget)"
+    )
     # Unselected experts' grads are exactly zero in both paths.
     sel = np.zeros(M, bool)
     sel[allowed] = True

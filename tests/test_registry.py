@@ -477,3 +477,29 @@ def test_heavy_registry_sharded_serve_hot_swaps_intrinsics(scenes):
             assert int(got["expert"]) == int(w["expert"])
             np.testing.assert_allclose(got["rvec"], w["rvec"], atol=1e-4)
             np.testing.assert_allclose(got["tvec"], w["tvec"], atol=1e-4)
+
+
+def test_prewarm_programs_compiles_slo_ladder_off_hot_path(scenes):
+    """SLO degradation (DESIGN.md §12) downshifts a lane to a cheaper-K
+    program of the same compiled family; ``prewarm_programs`` is the
+    operator hook that compiles the whole ladder BEFORE traffic, so even
+    the first degraded dispatch never compiles on the hot path.  Pins:
+    one program per (K, frame-bucket), zero additional compiles when the
+    prewarmed programs then serve real traffic at every K, and the K=M
+    rung bit-identical to dense (PR 4's zero-risk-fallback invariant
+    surviving through the prewarm path)."""
+    reg = SceneRegistry(_manifest(scenes, [("a", 1)]))
+    ladder = (None, 1, M)
+    n = reg.prewarm_programs("a", frame_buckets=CFG.frame_buckets,
+                             route_ks=ladder)
+    assert n == reg.compile_cache_size()
+    assert n == len(set(CFG.frame_buckets)) * len(ladder)
+    disp = reg.dispatcher(CFG, start_worker=False)
+    out_dense = disp.infer_one(_frame(0), scene="a")
+    out_k1 = disp.infer_one(_frame(0), scene="a", route_k=1)
+    out_km = disp.infer_one(_frame(0), scene="a", route_k=M)
+    assert reg.compile_cache_size() == n, "hot-path compile after prewarm"
+    assert _bitwise_equal(out_km, out_dense)  # K=M == dense, bit for bit
+    # K=1 genuinely runs the degraded program (a different expert subset
+    # can win, but the result is a real pose from a compiled program).
+    assert np.isfinite(np.asarray(out_k1["rvec"])).all()

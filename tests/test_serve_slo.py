@@ -1,0 +1,932 @@
+"""SLO serving tests: deadlines, admission control, degradation, watchdog.
+
+The load-bearing claims (ISSUE 7 acceptance):
+
+- no ``infer_one`` caller ever blocks past its deadline — queue expiry,
+  caller timeouts and the watchdog all wake waiters with TYPED errors,
+  including when the dispatch path is wedged (the observed relay-stall
+  mode, injected here via serve.slo.FaultInjector);
+- a dead worker / close() never strands a caller (the PR-2
+  unbounded-blocking bug, regression-pinned with a killed worker);
+- outcome accounting is exact: served + shed + expired + degraded +
+  failed (+ still-pending) == offered, in every scenario;
+- graceful degradation downshifts a lane's route_k to an
+  already-compiled static program and NEVER recompiles (jit cache-miss
+  counter pinned, the PR 3/4 pattern).
+
+Fakes are event-driven where possible; the timing-sensitive legs use
+margins sized for this 1-core container.  Heavy legs are
+``test_heavy_*`` + ``@pytest.mark.slow`` per the tier-1 budget rules.
+"""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from esac_tpu.ransac import RansacConfig
+from esac_tpu.serve import (
+    DeadlineExceededError,
+    DispatcherClosedError,
+    DispatchStalledError,
+    FaultInjector,
+    LaneQuarantinedError,
+    MicroBatchDispatcher,
+    ShedError,
+    SLOPolicy,
+    WorkerDiedError,
+    poisson_arrivals,
+    run_open_loop,
+    uniform_arrivals,
+)
+
+CFG = RansacConfig(n_hyps=8, refine_iters=2, frame_buckets=(1, 4))
+
+
+def _echo(tree, scene=None, route_k=None):
+    return {"echo": tree["x"]}
+
+
+def _frame(v=0.0):
+    return {"x": np.full(2, v, np.float32)}
+
+
+def _totals_consistent(disp):
+    t = disp.slo_totals()
+    assert (t["served"] + t["shed"] + t["expired"] + t["degraded"]
+            + t["failed"] + t["pending"] == t["offered"]), t
+    return t
+
+
+# ---------------- policy ----------------
+
+def test_slo_policy_validation_and_ladder():
+    with pytest.raises(ValueError):
+        SLOPolicy(deadline_ms=0)
+    with pytest.raises(ValueError):
+        SLOPolicy(degrade_queue_frac=0.0)
+    with pytest.raises(ValueError):
+        SLOPolicy(degrade_route_k=(0,))
+    with pytest.raises(ValueError):
+        SLOPolicy(watchdog_ms=0)
+    with pytest.raises(ValueError):
+        SLOPolicy(quarantine_after=0)
+    p = SLOPolicy(degrade_route_k=(1, 2, 4))
+    assert p.degrade_k(None) == 4       # dense -> largest rung
+    assert p.degrade_k(8) == 4          # one rung down, not a cliff
+    assert p.degrade_k(4) == 2
+    assert p.degrade_k(2) == 1
+    assert p.degrade_k(1) == 1          # bottom rung holds
+    assert SLOPolicy().degrade_k(8) == 8  # empty ladder = off
+    assert SLOPolicy().backoff_s(1) == pytest.approx(0.01)
+    assert SLOPolicy(retry_backoff_ms=100, retry_backoff_max_ms=150) \
+        .backoff_s(4) == pytest.approx(0.15)  # capped
+
+
+# ---------------- deadlines / timeouts ----------------
+
+def test_infer_one_timeout_is_a_hard_bound_and_late_result_is_discarded():
+    """A slow dispatch must not hold the caller past its timeout; the late
+    result is discarded (outcome stays expired, served not double-counted)."""
+    def slow(tree, scene=None, route_k=None):
+        time.sleep(0.5)
+        return {"echo": tree["x"]}
+
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,), serve_max_wait_ms=0.0)
+    disp = MicroBatchDispatcher(slow, cfg, slo=SLOPolicy())
+    t0 = time.perf_counter()
+    with pytest.raises(DeadlineExceededError):
+        disp.infer_one(_frame(), timeout=0.05)
+    assert time.perf_counter() - t0 < 0.4  # returned before the dispatch did
+    disp.close()  # joins the worker through the slow dispatch
+    t = _totals_consistent(disp)
+    assert t == {"offered": 1, "served": 0, "shed": 0, "expired": 1,
+                 "degraded": 0, "failed": 0, "pending": 0}
+
+
+def test_request_get_times_out_abandons_and_accounting_agrees():
+    """``get(timeout)`` mirrors ``infer_one``'s timeout: the request is
+    ABANDONED — the late result is discarded and the outcome accounting
+    says expired, agreeing with the error the caller saw (a served count
+    for a result nobody read would be a lie)."""
+    release = threading.Event()
+
+    def gated(tree, scene=None, route_k=None):
+        release.wait()
+        return {"echo": tree["x"]}
+
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,), serve_max_wait_ms=0.0)
+    disp = MicroBatchDispatcher(gated, cfg)
+    req = disp.submit(_frame())
+    with pytest.raises(DeadlineExceededError):
+        req.get(0.05)
+    assert req.done and req.outcome == "expired"
+    release.set()
+    with pytest.raises(DeadlineExceededError):
+        req.get(5.0)  # abandoned stays abandoned; late result discarded
+    disp.close()
+    t = _totals_consistent(disp)
+    assert t == {"offered": 1, "served": 0, "shed": 0, "expired": 1,
+                 "degraded": 0, "failed": 0, "pending": 0}
+
+
+def test_deadline_expires_in_queue_behind_a_slow_dispatch():
+    """Requests whose deadline passes while queued are failed by the
+    expiry sweep / pre-dispatch check — not dispatched late."""
+    def slow(tree, scene=None, route_k=None):
+        time.sleep(0.25)
+        return {"echo": tree["x"]}
+
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,), serve_max_wait_ms=0.0)
+    disp = MicroBatchDispatcher(
+        slow, cfg, slo=SLOPolicy(deadline_ms=350.0, watchdog_ms=5_000.0)
+    )
+    reqs = [disp.submit(_frame(i)) for i in range(3)]
+    for r in reqs:
+        assert r.event.wait(5.0)
+    disp.close()
+    # First served (~250ms < 350ms); the rest would land at ~500/750ms.
+    assert reqs[0].outcome == "served"
+    for r in reqs[1:]:
+        assert r.outcome == "expired"
+        assert isinstance(r.error, DeadlineExceededError)
+    t = _totals_consistent(disp)
+    assert t["served"] == 1 and t["expired"] == 2
+
+
+def test_explicit_deadline_honored_without_policy():
+    """An explicitly passed ``deadline_ms`` bounds the caller even with NO
+    SLOPolicy configured — silently ignoring a requested bound would
+    reintroduce the unbounded-blocking bug for exactly the caller who
+    asked not to have it (review regression)."""
+    release = threading.Event()
+
+    def gated(tree, scene=None, route_k=None):
+        release.wait()
+        return {"echo": tree["x"]}
+
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,), serve_max_wait_ms=0.0)
+    disp = MicroBatchDispatcher(gated, cfg)  # no slo
+    t0 = time.perf_counter()
+    with pytest.raises(DeadlineExceededError):
+        disp.infer_one(_frame(), deadline_ms=100.0)
+    assert time.perf_counter() - t0 < 2.0
+    release.set()
+    disp.close()
+    t = _totals_consistent(disp)
+    assert t["expired"] == 1 and t["served"] == 0
+
+
+def test_malformed_result_tree_fails_the_batch_not_the_worker():
+    """A result tree the fan-out cannot slice (scalar leaf) must fail THAT
+    batch with the raised error — not kill the worker and poison the
+    dispatcher (review regression: slicing used to run outside the
+    dispatch try)."""
+    calls = []
+
+    def weird(tree, scene=None, route_k=None):
+        calls.append(1)
+        if len(calls) == 1:
+            return {"echo": np.float32(1.0)}  # scalar leaf: unsliceable
+        return {"echo": tree["x"]}
+
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,), serve_max_wait_ms=0.0)
+    disp = MicroBatchDispatcher(weird, cfg)
+    with pytest.raises(Exception) as ei:
+        disp.infer_one(_frame(), timeout=10.0)
+    assert not isinstance(ei.value, (WorkerDiedError, DeadlineExceededError))
+    # The worker survived: the next request is served normally.
+    out = disp.infer_one(_frame(2.0), timeout=10.0)
+    assert out["echo"][0] == 2.0
+    disp.close()
+    t = _totals_consistent(disp)
+    assert t["failed"] == 1 and t["served"] == 1
+
+
+def test_accounting_invariant_holds_during_retry_backoff():
+    """The invariant is pinned at EVERY instant, including the retry
+    backoff window — an in-flight batch must stay registered as pending
+    while the worker sleeps between attempts (review regression)."""
+    inj = FaultInjector(_echo)
+    inj.fail_times(RuntimeError("transient"), times=1)
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,), serve_max_wait_ms=0.0)
+    slo = SLOPolicy(retry_max=1, retry_backoff_ms=300.0,
+                    retry_backoff_max_ms=300.0)
+    disp = MicroBatchDispatcher(inj, cfg, slo=slo)
+    req = disp.submit(_frame(4.0))
+    # Poll the accounting through the failure + backoff + retry window.
+    deadline = time.time() + 5.0
+    while not req.event.is_set() and time.time() < deadline:
+        _totals_consistent(disp)
+        time.sleep(0.01)
+    assert req.get(5.0)["echo"][0] == 4.0  # retried and served
+    disp.close()
+    t = _totals_consistent(disp)
+    assert t["served"] == 1 and t["failed"] == 0
+
+
+def test_sync_path_enforces_deadline_at_completion():
+    """The worker-less sync mode executes in the caller's thread and
+    cannot interrupt a dispatch, but a result landing past the requested
+    bound must raise (outcome expired), never be returned as served
+    (review regression)."""
+    def slow(tree, scene=None, route_k=None):
+        time.sleep(0.15)
+        return {"echo": tree["x"]}
+
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,))
+    disp = MicroBatchDispatcher(slow, cfg, start_worker=False)
+    with pytest.raises(DeadlineExceededError):
+        disp.infer_one(_frame(), deadline_ms=50.0)
+    with pytest.raises(DeadlineExceededError):
+        disp.infer_one(_frame(), timeout=0.05)
+    out = disp.infer_one(_frame(6.0), deadline_ms=60_000.0)
+    assert out["echo"][0] == 6.0
+    t = _totals_consistent(disp)
+    assert t["expired"] == 2 and t["served"] == 1
+
+
+def test_popped_batch_is_tracked_before_run_takes_over(monkeypatch):
+    """Between the worker popping a batch and _run re-registering it, the
+    requests must already ride _inflight — in neither table, a worker
+    death would strand their callers and pending would undercount
+    (review regression)."""
+    seen = []
+    orig_run = MicroBatchDispatcher._run
+
+    def spy(self, reqs, lane, eff_k, degraded, gen):
+        if gen is not None:  # worker path only; sync path has no gap
+            with self._lock:
+                infl = self._inflight
+            seen.append(infl is not None and infl.reqs == reqs)
+        return orig_run(self, reqs, lane, eff_k, degraded, gen)
+
+    monkeypatch.setattr(MicroBatchDispatcher, "_run", spy)
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,), serve_max_wait_ms=0.0)
+    disp = MicroBatchDispatcher(_echo, cfg)
+    disp.infer_one(_frame(), timeout=10.0)
+    disp.close()
+    assert seen == [True]
+
+
+def test_lone_tight_deadline_request_dispatches_early_not_expired():
+    """The coalescing hold must reserve dispatch headroom: a lone request
+    whose deadline is SHORTER than serve_max_wait_ms must be dispatched
+    early and served on an idle server — holding it to deadline-minus-EMA
+    (zero EMA before any dispatch) deterministically expired it (review
+    regression)."""
+    def quick(tree, scene=None, route_k=None):
+        time.sleep(0.005)
+        return {"echo": tree["x"]}
+
+    cfg = dataclasses.replace(CFG, frame_buckets=(4,),
+                              serve_max_wait_ms=200.0)
+    disp = MicroBatchDispatcher(quick, cfg, slo=SLOPolicy())
+    out = disp.infer_one(_frame(8.0), deadline_ms=100.0)
+    assert out["echo"][0] == 8.0
+    disp.close()
+    t = _totals_consistent(disp)
+    assert t["served"] == 1 and t["expired"] == 0
+
+
+def test_deadline_bounds_the_queue_space_wait_without_policy():
+    """A deadline-carrying request must not strand in the legacy
+    block-for-space wait behind a wedged dispatch (review regression:
+    the bound applies from the first instant, not only once queued)."""
+    release = threading.Event()
+
+    def gated(tree, scene=None, route_k=None):
+        release.wait()
+        return {"echo": tree["x"]}
+
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,), serve_max_wait_ms=0.0,
+                              serve_queue_depth=1)
+    disp = MicroBatchDispatcher(gated, cfg)  # no slo: blocking contract
+    first = disp.submit(_frame())           # -> in flight, wedged
+    filler = disp.submit(_frame())          # fills the depth-1 queue
+    t0 = time.perf_counter()
+    with pytest.raises(DeadlineExceededError):
+        disp.submit(_frame(), deadline_ms=150.0)  # space wait is bounded
+    assert time.perf_counter() - t0 < 2.0
+    t0 = time.perf_counter()
+    with pytest.raises(DeadlineExceededError):
+        disp.infer_one(_frame(), timeout=0.15)  # timeout rides as deadline
+    assert time.perf_counter() - t0 < 2.0
+    release.set()
+    for r in (first, filler):
+        assert r.event.wait(10.0) and r.error is None
+    disp.close()
+    t = _totals_consistent(disp)
+    assert t["served"] == 2 and t["expired"] == 2
+
+
+def test_infer_one_timeout_is_end_to_end_across_the_space_wait():
+    """``timeout`` is one budget for space-wait + queue + dispatch: time
+    spent blocked for queue space must not re-arm a fresh full timeout
+    once admitted (review regression: the caller could block ~2x the
+    requested bound)."""
+    gates = [threading.Event(), threading.Event(), threading.Event()]
+    calls = []
+
+    def gated(tree, scene=None, route_k=None):
+        gates[min(len(calls), 2)].wait()
+        calls.append(1)
+        return {"echo": tree["x"]}
+
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,), serve_max_wait_ms=0.0,
+                              serve_queue_depth=1)
+    disp = MicroBatchDispatcher(gated, cfg)  # no slo: blocking space wait
+    first = disp.submit(_frame())   # in flight, wedged on gates[0]
+    filler = disp.submit(_frame())  # fills the depth-1 queue
+    # Free the first two dispatches after ~1s so the timed caller's
+    # request is ADMITTED mid-budget, then wedge again on gates[2].
+    threading.Timer(1.0, gates[0].set).start()
+    threading.Timer(1.0, gates[1].set).start()
+    t0 = time.perf_counter()
+    with pytest.raises(DeadlineExceededError):
+        disp.infer_one(_frame(), timeout=1.5)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 2.2, f"caller blocked {elapsed:.2f}s on a 1.5s budget"
+    gates[2].set()
+    for r in (first, filler):
+        assert r.event.wait(10.0)
+    disp.close()
+    _totals_consistent(disp)
+
+
+# ---------------- close() / dead worker (the unbounded-blocking bug) ----
+
+def test_close_fails_pending_when_no_worker_ever_started():
+    disp = MicroBatchDispatcher(_echo, CFG, start_worker=False)
+    req = disp.submit(_frame())
+    disp.close()
+    assert req.event.is_set()
+    assert isinstance(req.error, DispatcherClosedError)
+    with pytest.raises(DispatcherClosedError):
+        disp.submit(_frame())
+    with pytest.raises(DispatcherClosedError):
+        req.get(0.0)
+    _totals_consistent(disp)
+
+
+class _Killed(BaseException):
+    """Non-Exception so it escapes the dispatch fan-out (simulates the
+    worker thread being killed mid-loop rather than a dispatch failing)."""
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_killed_worker_wakes_pending_callers_with_typed_error():
+    """Regression (ISSUE 7 satellite): a dead worker used to strand
+    ``infer_one`` callers forever on ``event.wait()``."""
+    def die(tree, scene=None, route_k=None):
+        raise _Killed("worker killed")
+
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,), serve_max_wait_ms=5.0)
+    disp = MicroBatchDispatcher(die, cfg)
+    got = {}
+
+    def caller():
+        try:
+            disp.infer_one(_frame())
+        except Exception as e:  # noqa: BLE001
+            got["err"] = e
+
+    t = threading.Thread(target=caller)
+    t.start()
+    t.join(10.0)
+    assert not t.is_alive(), "caller stranded by a dead worker"
+    assert isinstance(got["err"], WorkerDiedError)
+    # The poisoned dispatcher rejects new work with the same typed error.
+    with pytest.raises(WorkerDiedError):
+        disp.submit(_frame())
+    with pytest.raises(WorkerDiedError):
+        disp.infer_one(_frame())
+    t2 = _totals_consistent(disp)
+    assert t2["failed"] >= 1 and t2["pending"] == 0
+    disp.close()  # still clean after death
+
+
+# ---------------- admission control ----------------
+
+def test_queue_full_sheds_instead_of_blocking():
+    release = threading.Event()
+
+    def gated(tree, scene=None, route_k=None):
+        release.wait()
+        return {"echo": tree["x"]}
+
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,), serve_max_wait_ms=0.0,
+                              serve_queue_depth=2)
+    disp = MicroBatchDispatcher(gated, cfg, slo=SLOPolicy())
+    reqs = [disp.submit(_frame(i)) for i in range(2)]  # fills queue+inflight
+    # Wait until the worker has the first dispatch in flight, then top the
+    # queue back up so the NEXT submit sees a full queue deterministically.
+    deadline = time.time() + 5.0
+    while disp.slo_totals()["pending"] < 2 and time.time() < deadline:
+        reqs.append(disp.submit(_frame()))
+        time.sleep(0.01)
+    with pytest.raises(ShedError):
+        while True:  # at most a couple of admits before the bound hits
+            reqs.append(disp.submit(_frame()))
+    release.set()
+    for r in reqs:
+        assert r.event.wait(5.0)
+    disp.close()
+    t = _totals_consistent(disp)
+    assert t["shed"] >= 1 and t["served"] == len(reqs)
+
+
+def test_predicted_deadline_miss_sheds_at_submit():
+    def slow(tree, scene=None, route_k=None):
+        time.sleep(0.1)
+        return {"echo": tree["x"]}
+
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,), serve_max_wait_ms=0.0)
+    disp = MicroBatchDispatcher(slow, cfg, slo=SLOPolicy())
+    # Seed the dispatch-time EMA (~100ms) with TWO dispatches: a single
+    # sample never arms predicted-miss shedding — it could be a
+    # compile-inflated outlier, and shedding on it would poison a healthy
+    # server forever (regression for the EMA-poisoning review finding).
+    disp.infer_one(_frame())
+    # One sample: a hopeless deadline is still ADMITTED (the probe that
+    # keeps the EMA honest); it ends in a typed expiry either way —
+    # dropped expired in queue, or dispatched and landed late.
+    req = disp.submit(_frame(), deadline_ms=5.0)
+    with pytest.raises(DeadlineExceededError):
+        req.get(5.0)
+    assert req.outcome == "expired"
+    disp.infer_one(_frame())  # second completed dispatch arms shedding
+    with pytest.raises(ShedError):
+        disp.submit(_frame(), deadline_ms=5.0)  # now shed upfront
+    # A feasible deadline is still admitted.
+    out = disp.infer_one(_frame(), deadline_ms=5_000.0)
+    assert out["echo"][0] == 0.0
+    disp.close()
+    t = _totals_consistent(disp)
+    assert t["shed"] == 1 and t["served"] == 3 and t["expired"] == 1
+
+
+# ---------------- graceful degradation ----------------
+
+def test_overload_degrades_route_k_one_rung_and_accounts_it():
+    ks = []
+    lock = threading.Lock()
+
+    def recording(tree, scene=None, route_k=None):
+        with lock:
+            ks.append(route_k)
+        return {"echo": tree["x"]}
+
+    cfg = dataclasses.replace(CFG, frame_buckets=(2,), serve_max_wait_ms=5.0,
+                              serve_queue_depth=16)
+    slo = SLOPolicy(degrade_queue_frac=0.5, degrade_route_k=(1, 2))
+    disp = MicroBatchDispatcher(recording, cfg, start_worker=False, slo=slo)
+    reqs = [disp.submit(_frame(i), scene="s", route_k=4) for i in range(10)]
+    disp.start()
+    for r in reqs:
+        assert r.event.wait(10.0)
+    disp.close()
+    with lock:
+        seen = list(ks)
+    # Early dispatches ran above the 8-pending threshold -> K downshifted
+    # one rung (4 -> 2); the drained tail ran at the requested K.
+    assert set(seen) == {2, 4}
+    t = _totals_consistent(disp)
+    assert t["degraded"] > 0 and t["served"] > 0
+    assert t["degraded"] + t["served"] == 10
+    # The outcome log carries the effective K for degraded requests.
+    eff = {o[3] for o in disp.outcome_log if o[0] == "degraded"}
+    assert eff == {2}
+
+
+def test_sceneless_dense_lane_never_degrades():
+    ks = []
+    lock = threading.Lock()
+
+    def recording(tree, scene=None, route_k=None):
+        with lock:
+            ks.append(route_k)
+        return {"echo": tree["x"]}
+
+    cfg = dataclasses.replace(CFG, frame_buckets=(2,), serve_max_wait_ms=5.0,
+                              serve_queue_depth=4)
+    slo = SLOPolicy(degrade_queue_frac=0.25, degrade_route_k=(1, 2))
+    disp = MicroBatchDispatcher(recording, cfg, start_worker=False, slo=slo)
+    reqs = [disp.submit(_frame(i)) for i in range(4)]
+    disp.start()
+    for r in reqs:
+        assert r.event.wait(10.0)
+    disp.close()
+    with lock:
+        assert set(ks) == {None}  # a legacy one-arg infer fn stays legacy
+    t = _totals_consistent(disp)
+    assert t["degraded"] == 0 and t["served"] == 4
+
+
+# ---------------- watchdog / fault injection ----------------
+
+def test_watchdog_fails_wedged_dispatch_quarantines_and_keeps_serving():
+    """The relay-stall drill: lane "bad" wedges mid-dispatch; its callers
+    get a typed error WITHIN their deadline, the lane quarantines, and a
+    replacement worker keeps serving lane "good"."""
+    inj = FaultInjector(_echo)
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,), serve_max_wait_ms=0.0)
+    slo = SLOPolicy(deadline_ms=2_000.0, watchdog_ms=150.0,
+                    watchdog_poll_ms=10.0)
+    disp = MicroBatchDispatcher(inj, cfg, slo=slo)
+    release = threading.Event()
+    inj.stall_once(release)
+
+    t0 = time.perf_counter()
+    with pytest.raises(DispatchStalledError):
+        disp.infer_one(_frame(), scene="bad")
+    waited = time.perf_counter() - t0
+    assert waited < 2.0, "caller blocked past its deadline"
+    assert 0.1 < waited, "watchdog fired before the stall threshold"
+
+    # Lane quarantined: admission now sheds with the precise type.
+    with pytest.raises(LaneQuarantinedError):
+        disp.submit(_frame(), scene="bad")
+    assert ("bad", None) in disp.quarantined_lanes()
+
+    # Healthy lane still serves (replacement worker owns the queue).
+    out = disp.infer_one(_frame(7.0), scene="good", timeout=5.0)
+    assert out["echo"][0] == 7.0
+
+    # Unstick the wedged thread: its stale generation must DISCARD the
+    # late result (served count can't change for the failed request).
+    before = disp.slo_totals()
+    release.set()
+    time.sleep(0.2)
+    after = disp.slo_totals()
+    assert after["served"] == before["served"]
+
+    # Operator releases the lane after the fault clears: served again.
+    disp.release_lane(scene="bad")
+    out = disp.infer_one(_frame(9.0), scene="bad", timeout=5.0)
+    assert out["echo"][0] == 9.0
+    disp.close()
+    t = _totals_consistent(disp)
+    assert t["failed"] == 1 and t["shed"] == 1 and t["served"] == 2
+
+
+def test_watchdog_drains_quarantined_lane_backlog():
+    """Requests already queued behind a wedged dispatch must not re-wedge
+    the replacement worker: the backlog fails typed at quarantine time."""
+    inj = FaultInjector(_echo)
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,), serve_max_wait_ms=0.0)
+    slo = SLOPolicy(watchdog_ms=100.0, watchdog_poll_ms=10.0)
+    disp = MicroBatchDispatcher(inj, cfg, slo=slo)
+    release = threading.Event()
+    inj.stall_once(release)
+    reqs = [disp.submit(_frame(i), scene="bad") for i in range(3)]
+    for r in reqs:
+        assert r.event.wait(5.0)
+    assert isinstance(reqs[0].error, DispatchStalledError)
+    for r in reqs[1:]:
+        assert isinstance(r.error, LaneQuarantinedError)
+    release.set()
+    disp.close()
+    t = _totals_consistent(disp)
+    assert t["failed"] == 1 and t["shed"] == 2
+    assert inj.stats()["stalls"] == 1
+
+
+def test_transient_failure_retries_then_serves():
+    inj = FaultInjector(_echo)
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,), serve_max_wait_ms=0.0)
+    slo = SLOPolicy(retry_max=2, retry_backoff_ms=1.0)
+    disp = MicroBatchDispatcher(inj, cfg, slo=slo)
+    inj.fail_times(RuntimeError("transient"), times=2)
+    out = disp.infer_one(_frame(3.0), timeout=5.0)
+    assert out["echo"][0] == 3.0
+    disp.close()
+    t = _totals_consistent(disp)
+    assert t["served"] == 1 and t["failed"] == 0
+    assert inj.stats()["failures"] == 2
+
+
+def test_repeated_dispatch_failures_quarantine_the_lane():
+    inj = FaultInjector(_echo)
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,), serve_max_wait_ms=0.0)
+    slo = SLOPolicy(retry_max=0, quarantine_after=2)
+    disp = MicroBatchDispatcher(inj, cfg, slo=slo)
+    inj.fail_times(RuntimeError("hard fault"), times=10)
+    for _ in range(2):
+        with pytest.raises(RuntimeError, match="hard fault"):
+            disp.infer_one(_frame(), scene="flaky", timeout=5.0)
+    with pytest.raises(LaneQuarantinedError):
+        disp.submit(_frame(), scene="flaky")
+    # Other lanes unaffected; the injector has exhausted no further calls
+    # for them only if armed per-call — drain the remaining failures first.
+    disp.release_lane(scene="flaky")
+    inj.fail_times(RuntimeError("x"), times=0)
+    out = disp.infer_one(_frame(5.0), scene="ok", timeout=5.0)
+    assert out["echo"][0] == 5.0
+    disp.close()
+    t = _totals_consistent(disp)
+    assert t["failed"] == 2 and t["shed"] == 1 and t["served"] == 1
+
+
+# ---------------- open-loop load generation ----------------
+
+def test_arrival_schedules_deterministic_and_rate_true():
+    a = poisson_arrivals(100.0, 500, seed=7)
+    b = poisson_arrivals(100.0, 500, seed=7)
+    assert np.array_equal(a, b)
+    assert np.all(np.diff(a) > 0) or np.all(np.diff(a) >= 0)
+    # Mean rate within 20% of target at n=500.
+    assert 80.0 < 500 / a[-1] < 125.0
+    u = uniform_arrivals(50.0, 10)
+    assert u[0] == pytest.approx(0.02) and u[-1] == pytest.approx(0.2)
+    with pytest.raises(ValueError):
+        poisson_arrivals(0.0, 5)
+
+
+def test_run_open_loop_accounting_matches_dispatcher():
+    cfg = dataclasses.replace(CFG, frame_buckets=(1, 4),
+                              serve_max_wait_ms=1.0, serve_queue_depth=64)
+    disp = MicroBatchDispatcher(_echo, cfg, slo=SLOPolicy(deadline_ms=2_000))
+    res = run_open_loop(
+        disp,
+        lambda i: (_frame(i), f"s{i % 2}", None),
+        uniform_arrivals(400.0, 60),
+        deadline_ms=2_000.0,
+        hyps_per_request=8,
+    )
+    disp.close()
+    assert res["offered"] == 60
+    assert res["outcomes"]["lost"] == 0
+    assert sum(res["outcomes"][o] for o in
+               ("served", "degraded", "shed", "expired", "failed")) == 60
+    t = _totals_consistent(disp)
+    assert t["offered"] == 60
+    # The loadgen's view and the dispatcher's accounting agree per class.
+    for o in ("served", "degraded", "shed", "expired", "failed"):
+        assert res["outcomes"][o] == t[o], (o, res["outcomes"], t)
+    assert res["outcomes"]["served"] > 0
+    assert res["sustained_hyps_per_s"] > 0
+    assert np.isfinite(res["p50_ms"]) and res["p99_ms"] >= res["p50_ms"]
+
+
+def test_run_open_loop_survives_space_wait_expiry_without_policy():
+    """A no-SLO dispatcher's bounded space wait raises
+    DeadlineExceededError (not a ShedError); the loadgen must record that
+    request as expired and keep the point's outcomes, not crash (review
+    regression)."""
+    def slowish(tree, scene=None, route_k=None):
+        time.sleep(0.05)
+        return {"echo": tree["x"]}
+
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,), serve_max_wait_ms=0.0,
+                              serve_queue_depth=1)
+    disp = MicroBatchDispatcher(slowish, cfg)  # no slo: blocking contract
+    res = run_open_loop(
+        disp,
+        lambda i: (_frame(i), None, None),
+        uniform_arrivals(200.0, 20),  # 10x over capacity: queue stays full
+        deadline_ms=120.0,
+        hyps_per_request=1,
+    )
+    disp.close()
+    assert res["outcomes"]["lost"] == 0
+    assert sum(res["outcomes"][o] for o in
+               ("served", "degraded", "shed", "expired", "failed")) == 20
+    assert res["outcomes"]["expired"] > 0  # space-wait expiries recorded
+    _totals_consistent(disp)
+
+
+def test_reset_stats_mid_traffic_rebases_offered_and_invariant_survives():
+    """reset_stats on a busy server re-bases ``offered`` to the unresolved
+    requests, so the accounting invariant keeps holding once they land
+    (review regression: zeroing offered broke it forever)."""
+    release = threading.Event()
+
+    def gated(tree, scene=None, route_k=None):
+        release.wait()
+        return {"echo": tree["x"]}
+
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,), serve_max_wait_ms=0.0)
+    disp = MicroBatchDispatcher(gated, cfg)
+    reqs = [disp.submit(_frame(i)) for i in range(3)]
+    disp.reset_stats()  # one in flight + two queued, none resolved
+    t = _totals_consistent(disp)
+    assert t["offered"] == 3 and t["pending"] == 3
+    release.set()
+    for r in reqs:
+        assert r.event.wait(10.0)
+    disp.close()
+    t = _totals_consistent(disp)
+    assert t["offered"] == 3 and t["served"] == 3 and t["pending"] == 0
+
+
+# ---------------- degradation never recompiles (real programs) ----------
+
+def test_degraded_dispatch_reuses_compiled_program_bit_identical():
+    """The acceptance pin: degrading route_k under overload swaps to an
+    ALREADY-COMPILED static program — the jit cache-miss counter does not
+    move, and the degraded result is bit-identical to calling the K=2
+    program directly (it IS that program)."""
+    import jax
+
+    from esac_tpu.registry import (
+        ScenePreset, make_routed_scene_bucket_fn, make_scene_bucket_fn,
+    )
+
+    H = W = 16
+    M, B = 4, 2
+    preset = ScenePreset(
+        height=H, width=W, num_experts=M,
+        stem_channels=(2, 2, 2), head_channels=2, head_depth=1,
+        gating_channels=(2,), compute_dtype="float32", gated=True,
+    )
+    kcfg = RansacConfig(n_hyps=4, refine_iters=1, polish_iters=1,
+                        frame_buckets=(B,), serve_max_wait_ms=5.0,
+                        serve_queue_depth=8)
+
+    from esac_tpu.models.expert import ExpertNet
+    from esac_tpu.models.gating import GatingNet
+
+    expert = ExpertNet(scene_center=(0.0, 0.0, 0.0),
+                       stem_channels=preset.stem_channels,
+                       head_channels=preset.head_channels,
+                       head_depth=preset.head_depth,
+                       compute_dtype=jax.numpy.float32)
+    gating = GatingNet(num_experts=M, channels=preset.gating_channels,
+                       compute_dtype=jax.numpy.float32)
+    img = jax.numpy.zeros((1, H, W, 3))
+    params = {
+        "expert": jax.vmap(lambda k: expert.init(k, img))(
+            jax.random.split(jax.random.key(0), M)
+        ),
+        "gating": gating.init(jax.random.key(1), img),
+        "centers": jax.numpy.zeros((M, 3)),
+        "c": jax.numpy.asarray([W / 2.0, H / 2.0]),
+        "f": jax.numpy.float32(20.0),
+    }
+    fns = {
+        M: make_scene_bucket_fn(preset, kcfg),  # route_k=M lane -> dense math
+        2: make_routed_scene_bucket_fn(preset, kcfg, 2),
+    }
+
+    def serve(tree, scene, route_k=None):
+        return fns[route_k](params, tree)
+
+    serve._cache_size = lambda: sum(
+        f._cache_size() for f in fns.values()
+    )
+
+    def frame(i):
+        return {
+            "key": jax.random.fold_in(jax.random.key(5), i),
+            "image": np.asarray(jax.random.uniform(
+                jax.random.fold_in(jax.random.key(6), i), (H, W, 3)
+            )),
+        }
+
+    # Warm BOTH programs (the prewarm discipline: the ladder is compiled
+    # before overload ever hits).
+    slo = SLOPolicy(degrade_queue_frac=0.5, degrade_route_k=(2,))
+    disp = MicroBatchDispatcher(serve, kcfg, start_worker=False, slo=slo)
+    warm = disp.infer_many([frame(0), frame(1)], scene="s", route_k=M)
+    direct = disp.infer_many([frame(0), frame(1)], scene="s", route_k=2)
+    compiled = disp.cache_size()
+    assert compiled == 2  # one program per (K, bucket)
+    disp.reset_stats()  # the warmup dispatches are not part of the drill
+
+    # Overload the queue so the worker degrades the K=M lane to K=2.
+    reqs = [disp.submit(frame(i % 2), scene="s", route_k=M,
+                        deadline_ms=600_000.0) for i in range(8)]
+    disp.start()
+    for r in reqs:
+        assert r.event.wait(120.0)
+    disp.close()
+    t = _totals_consistent(disp)
+    assert t["degraded"] > 0 and t["served"] > 0
+    assert t["degraded"] + t["served"] == 8
+    assert disp.cache_size() == compiled, \
+        "degradation compiled a new program"
+    # Degraded results ARE the K=2 program's results, bit for bit; the
+    # non-degraded tail still matches the requested-K program.
+    for idx, r in enumerate(reqs):
+        want = direct[idx % 2] if r.outcome == "degraded" else warm[idx % 2]
+        for key in ("rvec", "tvec", "scores"):
+            assert np.array_equal(np.asarray(r.result[key]),
+                                  np.asarray(want[key])), (idx, key)
+
+
+# ---------------- heavy leg: open-loop stall drill ----------------
+
+@pytest.mark.slow
+def test_heavy_open_loop_stall_recovery_accounting_and_bit_parity():
+    """The full drill (ISSUE 7 satellite): open-loop submitters over real
+    compute + an injected mid-stream stall.  Pins that (a) the watchdog
+    fires and every pending caller errors within its deadline, (b) the
+    accounting sums exactly to offered, and (c) post-recovery results are
+    bit-identical to an unfaulted run of the same frames."""
+    import jax
+
+    from esac_tpu.data import CAMERA_F, make_correspondence_frame
+    from esac_tpu.serve import make_dsac_serve_fn
+
+    C = (80.0, 60.0)
+    F4 = CAMERA_F / 4.0
+    cfg = dataclasses.replace(CFG, frame_buckets=(1, 4),
+                              serve_max_wait_ms=1.0, serve_queue_depth=32)
+    dsac = make_dsac_serve_fn(C, cfg)
+
+    def serve(tree, scene=None, route_k=None):
+        return dsac(tree)
+
+    serve._cache_size = dsac._cache_size
+
+    def frames(n, seed=0):
+        out = []
+        for i in range(n):
+            fr = make_correspondence_frame(
+                jax.random.key(seed + i), noise=0.01, outlier_frac=0.3,
+                height=120, width=160, f=F4, c=C,
+            )
+            out.append({
+                "key": jax.random.fold_in(jax.random.key(99), i),
+                "coords": np.asarray(fr["coords"]),
+                "pixels": np.asarray(fr["pixels"]),
+                "f": np.float32(F4),
+            })
+        return out
+
+    fleet = frames(8)
+    # Ground truth: unfaulted closed-loop run.
+    clean = MicroBatchDispatcher(serve, cfg, start_worker=False)
+    want = [clean.infer_one(fr, scene="a") for fr in fleet]
+
+    inj = FaultInjector(serve)
+    slo = SLOPolicy(deadline_ms=30_000.0, watchdog_ms=1_500.0,
+                    watchdog_poll_ms=25.0)
+    disp = MicroBatchDispatcher(inj, cfg, slo=slo)
+    # Warm the buckets through the faulted dispatcher first (compile time
+    # must not read as a stall).
+    disp.infer_one(fleet[0], scene="a", timeout=120.0)
+    disp.infer_many(fleet[:4], scene="a")
+
+    release = threading.Event()
+    inj.stall_once(release, after=2)  # wedge mid-stream, not at the start
+
+    stop = threading.Event()
+    errors: list = []
+    outcomes: list = []
+    olock = threading.Lock()
+
+    def submitter(tid):
+        i = 0
+        while not stop.is_set():
+            try:
+                out = disp.infer_one(fleet[(tid + i) % len(fleet)],
+                                     scene="a", timeout=20.0)
+                with olock:
+                    outcomes.append(("ok", out))
+            except (DispatchStalledError, LaneQuarantinedError,
+                    ShedError, DeadlineExceededError) as e:
+                with olock:
+                    outcomes.append(("err", type(e).__name__))
+            except Exception as e:  # noqa: BLE001 — real failures surface
+                errors.append(e)
+                return
+            i += 1
+            time.sleep(0.02)
+
+    threads = [threading.Thread(target=submitter, args=(t,))
+               for t in range(3)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    # Let the stall hit and the watchdog fire, then recover.
+    time.sleep(4.0)
+    stop.set()
+    for t in threads:
+        t.join(30.0)
+        assert not t.is_alive(), "submitter stranded past its deadline"
+    assert time.perf_counter() - t0 < 60.0
+    assert errors == [], errors
+    assert ("a", None) in disp.quarantined_lanes()
+    with olock:
+        assert any(o[0] == "err" for o in outcomes)
+    totals = _totals_consistent(disp)
+    assert totals["failed"] >= 1 and totals["pending"] == 0
+
+    # Recovery: unstick the wedged thread, release the lane, re-serve the
+    # SAME frames — bit-identical to the unfaulted run.
+    release.set()
+    time.sleep(0.1)
+    disp.release_lane(scene="a")
+    for fr, w in zip(fleet, want):
+        got = disp.infer_one(fr, scene="a", timeout=120.0)
+        for key in ("rvec", "tvec", "scores"):
+            assert np.array_equal(np.asarray(got[key]),
+                                  np.asarray(w[key])), key
+    disp.close()
+    _totals_consistent(disp)
