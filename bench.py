@@ -70,6 +70,13 @@ LOADTEST_MULTS = (0.4, 0.8, 1.2, 2.0)  # offered load as a multiple of the
                                        # two points below the knee, two past
 LOADTEST_SECONDS = 2.5   # open-loop window per load point
 
+SCORING_SWEEP = (64, 256, 1024)  # n_hyps sweep: the fused-select advantage
+                                 # must GROW along this axis (the errmap
+                                 # term is B*n_hyps*n_cells*4 bytes)
+SCORING_BATCH = 16       # frames per dispatch: the serve operating point
+                         # (BENCH default dispatch, DESIGN.md §9)
+SCORING_REPEATS = 5      # median-of-5 per (impl, n_hyps) leg (CPU jitter)
+
 ROUTED_M = 8             # experts in the routed-serve sweep
 ROUTED_FRAMES = 16       # frames per dispatch (one frame bucket)
 ROUTED_HYPS = 8          # per-expert hyps at dense; total M*this is FIXED
@@ -86,6 +93,7 @@ _SERVE_FILE = _REPO / ".serve_amortization.json"
 _REGISTRY_FILE = _REPO / ".registry_swap.json"
 _ROUTED_FILE = _REPO / ".routed_serve.json"
 _LOADTEST_FILE = _REPO / ".serve_loadtest.json"
+_SCORING_FILE = _REPO / ".scoring_fused.json"
 
 
 def _measure_jax(
@@ -620,6 +628,111 @@ def _measure_routed(
     }
 
 
+def _measure_scoring(
+    n_hyps_sweep: tuple = SCORING_SWEEP,
+    batch: int = SCORING_BATCH,
+    repeats: int = SCORING_REPEATS,
+) -> dict:
+    """n_hyps x scoring-impl sweep of the frames-major inference entry
+    (ISSUE 8 / ROADMAP item 3): ``dsac_infer_frames`` at the serve
+    operating point (SCORING_BATCH frames, the full 4800-cell grid) for
+    every n_hyps in the sweep, under {errmap, fused, fused_select}.
+
+    What each leg measures is the SERVED structure: since ISSUE 8 the
+    "errmap"/"fused" inference paths stream scoring through score_chunk
+    tiles too (the errmap never materializes on any inference entry), so
+    the errmap-vs-fused_select gap isolates what fusing SELECTION into the
+    stream buys on top of the chunked scoring — on TPU that is the VMEM
+    kernel never writing even the (n_hyps,) score vector to HBM; on this
+    CPU box the chunked XLA sibling, where near-parity is the honest
+    expectation and the winner must agree bit-for-bit.
+
+    Per point the winner agreement is RECORDED, not assumed:
+    ``winner_bit_identical`` pins fused_select's (best index, refined
+    pose, inlier_frac) against the errmap argmax.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from esac_tpu.data import CAMERA_F, make_correspondence_frame
+    from esac_tpu.ransac import RansacConfig, dsac_infer_frames
+
+    keys0 = jax.random.split(jax.random.key(0), batch)
+    frames = [
+        make_correspondence_frame(k, noise=0.01, outlier_frac=0.3)
+        for k in keys0
+    ]
+    coords = jnp.stack([f["coords"] for f in frames])
+    pixels = jnp.stack([f["pixels"] for f in frames])
+    f_b = jnp.full((batch,), CAMERA_F, jnp.float32)
+    c_pt = jnp.asarray(C)
+    n_cells = coords.shape[1]
+    rkeys = jax.random.split(jax.random.key(1), batch)
+
+    impls = ("errmap", "fused", "fused_select")
+    curve = []
+    for n_hyps in n_hyps_sweep:
+        point = {
+            "n_hyps": int(n_hyps),
+            "total_hyps_per_dispatch": int(batch * n_hyps),
+            "errmap_term_mb": round(batch * n_hyps * n_cells * 4 / 1e6, 2),
+            "impls": {},
+        }
+        outs = {}
+        for impl in impls:
+            cfg = RansacConfig(n_hyps=int(n_hyps), scoring_impl=impl)
+            out = dsac_infer_frames(rkeys, coords, pixels, f_b, c_pt, cfg)
+            jax.block_until_ready(out["rvec"])  # compile + warm
+            walls = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                out = dsac_infer_frames(rkeys, coords, pixels, f_b, c_pt, cfg)
+                jax.block_until_ready(out["rvec"])
+                walls.append(time.perf_counter() - t0)
+            walls.sort()
+            dt = walls[len(walls) // 2]
+            outs[impl] = out
+            point["impls"][impl] = {
+                "dispatch_ms": round(dt * 1e3, 2),
+                "hyps_per_s": round(batch * n_hyps / dt, 1),
+                "wall_s_spread": [round(x, 4) for x in walls],
+            }
+        em = outs["errmap"]
+        fs = outs["fused_select"]
+        point["winner_bit_identical"] = bool(
+            np.array_equal(np.asarray(em["best"]), np.asarray(fs["best"]))
+            and np.array_equal(np.asarray(em["rvec"]), np.asarray(fs["rvec"]))
+            and np.array_equal(np.asarray(em["tvec"]), np.asarray(fs["tvec"]))
+            and np.array_equal(
+                np.asarray(em["inlier_frac"]), np.asarray(fs["inlier_frac"])
+            )
+        )
+        point["fused_select_speedup_x"] = round(
+            point["impls"]["fused_select"]["hyps_per_s"]
+            / point["impls"]["errmap"]["hyps_per_s"], 3,
+        )
+        curve.append(point)
+
+    return {
+        "batch_frames": batch,
+        "n_cells": int(n_cells),
+        "n_hyps_sweep": [int(h) for h in n_hyps_sweep],
+        "curve": curve,
+        "winner_bit_identical_all": bool(
+            all(p["winner_bit_identical"] for p in curve)
+        ),
+        "note": (
+            "full dsac_infer_frames pipeline at the serve frame bucket; "
+            "every impl streams scoring in score_chunk tiles (no errmap "
+            "on any inference path since ISSUE 8), so fused_select's "
+            "speedup isolates fusing SELECTION into the stream; "
+            "errmap_term_mb is the per-dispatch HBM the pre-ISSUE-8 "
+            "errmap path would have materialized"
+        ),
+    }
+
+
 def _loadtest_knee(points: list) -> dict | None:
     """The knee of one leg: the LAST point of the longest goodput>=0.99
     prefix of the (ascending-load) sweep — a load above a point the
@@ -934,6 +1047,8 @@ def device_child(kwargs: dict) -> None:
         payload = {"routed": _measure_routed(**kwargs)}
     elif kwargs.pop("loadtest", False):
         payload = {"loadtest": _measure_loadtest(**kwargs)}
+    elif kwargs.pop("scoring", False):
+        payload = {"scoring": _measure_scoring(**kwargs)}
     else:
         payload = {"rate": _measure_jax(**kwargs)}
     import jax
@@ -1426,6 +1541,56 @@ def _routed_main(stopped: list[int], load_before: list[float]) -> None:
     print(json.dumps(out))
 
 
+def _scoring_main(stopped: list[int], load_before: list[float]) -> None:
+    """``python bench.py scoring`` — the ISSUE 8 n_hyps x scoring-impl
+    sweep, wedge-safe like every other mode: the device leg runs in a
+    detached child (never killed), and on a wedged relay the sweep is
+    measured on the CPU backend, flagged via "note".  Records
+    .scoring_fused.json with the same contention provenance."""
+    note = None
+    res = measure_on_device({"scoring": True})
+    if res is None or "scoring" not in res:
+        note = (
+            "device measurement unavailable (relay wedged or child failed); "
+            "scoring sweep measured on CPU."
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        scoring = _measure_scoring()
+        platform, device_kind = "cpu", None
+    else:
+        scoring = res["scoring"]
+        platform, device_kind = res.get("platform"), res.get("device_kind")
+        if platform == "cpu":
+            note = "measurement child ran on CPU backend (no device visible)"
+    top = scoring["curve"][-1]  # the largest-n_hyps point is the headline
+    out = {
+        "metric": f"scoring_fused_select_hyps_per_s_at_{top['n_hyps']}",
+        "value": top["impls"]["fused_select"]["hyps_per_s"],
+        "unit": "hyps/s",
+        "vs_baseline": None,
+        "fused_select_speedup_x_at_max": top["fused_select_speedup_x"],
+        "winner_bit_identical_all": scoring["winner_bit_identical_all"],
+        "scoring": scoring,
+    }
+    if note:
+        out["note"] = note
+    if device_kind:
+        out["device_kind"] = device_kind
+    out["contention"] = _contention_block(stopped, load_before)
+    artifact = {
+        **out,
+        "platform": platform,
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+    }
+    tmp = str(_SCORING_FILE) + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(artifact, fh, indent=1)
+    os.replace(tmp, _SCORING_FILE)
+    print(json.dumps(out))
+
+
 def _loadtest_main(stopped: list[int], load_before: list[float]) -> None:
     """``python bench.py loadtest`` — the DESIGN.md §12 open-loop SLO
     sweep, wedge-safe like every other mode: the device leg runs in a
@@ -1498,6 +1663,9 @@ def _main_measured(stopped: list[int], load_before: list[float]) -> None:
         return
     if len(sys.argv) > 1 and sys.argv[1] == "loadtest":
         _loadtest_main(stopped, load_before)
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "scoring":
+        _scoring_main(stopped, load_before)
         return
     streaming = len(sys.argv) > 1 and sys.argv[1] == "streaming"
     kwargs = (

@@ -53,13 +53,25 @@ FLOPS_TOL = 1.25
 
 _TOP_N = 5
 
-# Entries whose trace is known to materialize the per-hypothesis
-# reprojection-error map the argmax immediately consumes (the DESIGN.md §9
-# fusion target).  Dims are the registry builders' trace shapes; the ledger
-# records the implied errmap bytes and whether a tensor of exactly that
-# footprint is present in the trace.
+# Entries audited for the per-hypothesis reprojection-error map that the
+# selection argmax immediately consumes (the DESIGN.md §9 fusion target).
+# Dims are the registry builders' trace shapes; the ledger records the
+# implied errmap bytes and whether a tensor of exactly that footprint is
+# present in the trace.  Since ISSUE 8 every INFERENCE entry streams
+# scoring+selection through (score_chunk, n_cells) tiles, so
+# ``present_in_trace`` must read false there — the committed record IS the
+# "errmap materialization gone" evidence; the materializing training path
+# (scoring_errmap_grad) keeps it true.
 _ERRMAP_DIMS = {
-    "esac_infer_frames": {"B": 2, "M": 2, "n_hyps": 8, "n_cells": 16},
+    "dsac_infer": {"n_hyps": 8, "n_cells": 128},
+    "dsac_infer_fused_select": {"n_hyps": 8, "n_cells": 128},
+    "dsac_infer_frames": {"B": 2, "n_hyps": 8, "n_cells": 128},
+    "esac_infer_frames": {"B": 2, "M": 2, "n_hyps": 8, "n_cells": 128},
+    "esac_infer_topk_frames": {"B": 2, "k": 2, "n_hyps": 8, "n_cells": 128},
+    # Routed trace: K=2 of M=4 experts, budget reallocated to
+    # n_hyps * M // K = 16 per evaluated slot.
+    "esac_infer_routed_frames": {"B": 2, "K": 2, "n_hyps": 16,
+                                 "n_cells": 128},
     "scoring_errmap_grad": {"n_hyps": 4, "n_cells": 16},
 }
 
@@ -259,9 +271,15 @@ def _errmap_record(name: str, stats: dict) -> dict | None:
         return None
     elems = math.prod(dims.values())
     nbytes = 4 * elems  # f32 reprojection errors
+    # An errmap is a tensor whose TRAILING axes are (n_hyps, n_cells) at
+    # the full trace element count — matching on byte count alone
+    # false-positives on unrelated same-size tensors (e.g. projection
+    # tiles), which is exactly the record this field must not corrupt.
+    nh, nc = dims["n_hyps"], dims["n_cells"]
     present = any(
-        b == nbytes and dtype == "float32"
-        for b, _, _, dtype in stats["_all_tensors"]
+        dtype == "float32" and b == nbytes
+        and len(shape) >= 2 and tuple(shape[-2:]) == (nh, nc)
+        for b, _, shape, dtype in stats["_all_tensors"]
     )
     return {
         "bytes_at_trace_shapes": nbytes,

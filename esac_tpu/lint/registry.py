@@ -32,17 +32,25 @@ class Entry:
     note: str = ""
 
 
-def _geom_inputs():
+def _geom_inputs(n_cells: int = 16):
     import jax
     import jax.numpy as jnp
 
-    N = 16
     k = jax.random.key(0)
-    coords = jax.random.uniform(k, (N, 3), minval=-1.0, maxval=1.0)
-    pixels = jax.random.uniform(jax.random.key(1), (N, 2), maxval=64.0)
+    coords = jax.random.uniform(k, (n_cells, 3), minval=-1.0, maxval=1.0)
+    pixels = jax.random.uniform(jax.random.key(1), (n_cells, 2), maxval=64.0)
     f = jnp.float32(60.0)
     c = jnp.asarray([32.0, 24.0])
     return coords, pixels, f, c
+
+
+# Inference entries trace at a cell count where the scoring stage (the
+# only stage scaling as hyps x cells) carries the peak — at the default 16
+# cells the P3P/refine small-tensor chain masks it, and the ledger's
+# peak-bytes record would not witness the ISSUE 8 fusion (errmap gone from
+# every inference entry).  128 cells keeps tracing fast while putting the
+# would-be errmap (n_hyps * 128 * 4 bytes) decisively above the chain.
+_INFER_CELLS = 128
 
 
 def _build_pnp_minimal_grad():
@@ -86,8 +94,11 @@ def _build_dsac_infer():
     from esac_tpu.ransac.config import RansacConfig
     from esac_tpu.ransac.kernel import dsac_infer
 
-    coords, pixels, f, c = _geom_inputs()
-    cfg = RansacConfig(n_hyps=8, refine_iters=2, polish_iters=1)
+    coords, pixels, f, c = _geom_inputs(_INFER_CELLS)
+    # score_chunk < n_hyps so the streamed inference scoring's real tiled
+    # structure is traced (n_tiles > 1), exactly as serve shapes see it.
+    cfg = RansacConfig(n_hyps=8, refine_iters=2, polish_iters=1,
+                       score_chunk=4)
     key = jax.random.key(2)
     return jax.make_jaxpr(
         lambda k, co: dsac_infer(k, co, pixels, f, c, cfg)
@@ -124,7 +135,9 @@ def _build_scoring(impl: str):
         from esac_tpu.ransac.kernel import _score_hypotheses
 
         coords, pixels, f, c = _geom_inputs()
-        cfg = RansacConfig(n_hyps=4, scoring_impl=impl)
+        # score_chunk < n_hyps so the "fused_select" training path's real
+        # tiled scan (2 tiles) is traced; errmap/fused ignore the knob.
+        cfg = RansacConfig(n_hyps=4, scoring_impl=impl, score_chunk=2)
         rvecs = jnp.tile(jnp.asarray([0.1, -0.05, 0.02]), (4, 1))
         tvecs = jnp.tile(jnp.asarray([0.0, 0.0, 2.0]), (4, 1))
         key = jax.random.key(4)
@@ -137,6 +150,44 @@ def _build_scoring(impl: str):
         return jax.make_jaxpr(jax.grad(loss))(coords)
 
     return build
+
+
+def _build_scoring_fused_select_grad():
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.geometry.rotations import rodrigues
+    from esac_tpu.ransac.pallas_scoring import soft_inlier_score_select
+
+    coords, pixels, f, c = _geom_inputs()
+    rvecs = jnp.asarray([[0.1, -0.05, 0.02], [0.0, 0.1, -0.1],
+                         [-0.2, 0.0, 0.05], [0.05, 0.05, 0.0]])
+    Rs = jax.vmap(rodrigues)(rvecs)
+    ts = jnp.tile(jnp.asarray([0.0, 0.0, 2.0]), (4, 1))
+
+    def loss(coords):
+        _, best_score = soft_inlier_score_select(
+            Rs, ts, coords, pixels, f, c, 10.0, 0.5,
+            use_pallas=False, chunk=2,
+        )
+        return best_score
+
+    return jax.make_jaxpr(jax.grad(loss))(coords)
+
+
+def _build_dsac_infer_fused_select():
+    import jax
+
+    from esac_tpu.ransac.config import RansacConfig
+    from esac_tpu.ransac.kernel import dsac_infer
+
+    coords, pixels, f, c = _geom_inputs(_INFER_CELLS)
+    cfg = RansacConfig(n_hyps=8, refine_iters=2, polish_iters=1,
+                       score_chunk=4, scoring_impl="fused_select")
+    key = jax.random.key(12)
+    return jax.make_jaxpr(
+        lambda k, co: dsac_infer(k, co, pixels, f, c, cfg)
+    )(key, coords)
 
 
 def _build_esac_train_grad():
@@ -172,9 +223,10 @@ def _build_dsac_infer_frames():
     from esac_tpu.ransac.config import RansacConfig
     from esac_tpu.ransac.kernel import dsac_infer_frames
 
-    coords, pixels, f, c = _geom_inputs()
+    coords, pixels, f, c = _geom_inputs(_INFER_CELLS)
     B = 2
-    cfg = RansacConfig(n_hyps=8, refine_iters=2, polish_iters=1)
+    cfg = RansacConfig(n_hyps=8, refine_iters=2, polish_iters=1,
+                       score_chunk=4)
     keys = jax.random.split(jax.random.key(6), B)
     coords_B = jnp.stack([coords, coords + 0.1])
     pixels_B = jnp.stack([pixels, pixels])
@@ -191,9 +243,10 @@ def _build_esac_infer_frames():
     from esac_tpu.ransac.config import RansacConfig
     from esac_tpu.ransac.esac import esac_infer_frames
 
-    coords, pixels, f, c = _geom_inputs()
+    coords, pixels, f, c = _geom_inputs(_INFER_CELLS)
     B, M = 2, 2
-    cfg = RansacConfig(n_hyps=8, refine_iters=2, polish_iters=1)
+    cfg = RansacConfig(n_hyps=8, refine_iters=2, polish_iters=1,
+                       score_chunk=4)
     keys = jax.random.split(jax.random.key(7), B)
     coords_all = jnp.stack([coords, coords + 0.1])          # (M, N, 3)
     coords_B = jnp.stack([coords_all, coords_all + 0.05])   # (B, M, N, 3)
@@ -212,9 +265,10 @@ def _build_esac_infer_topk_frames():
     from esac_tpu.ransac.config import RansacConfig
     from esac_tpu.ransac.esac import esac_infer_topk_frames
 
-    coords, pixels, f, c = _geom_inputs()
+    coords, pixels, f, c = _geom_inputs(_INFER_CELLS)
     B, M = 2, 3
-    cfg = RansacConfig(n_hyps=8, refine_iters=2, polish_iters=1)
+    cfg = RansacConfig(n_hyps=8, refine_iters=2, polish_iters=1,
+                       score_chunk=4)
     keys = jax.random.split(jax.random.key(9), B)
     coords_all = jnp.stack([coords, coords + 0.1, coords - 0.1])  # (M, N, 3)
     coords_B = jnp.stack([coords_all, coords_all + 0.05])         # (B, M, N, 3)
@@ -237,9 +291,10 @@ def _build_esac_infer_routed_frames():
     from esac_tpu.ransac.config import RansacConfig
     from esac_tpu.ransac.esac import esac_infer_routed_frames
 
-    coords, pixels, f, c = _geom_inputs()
+    coords, pixels, f, c = _geom_inputs(_INFER_CELLS)
     B, M, K = 2, 4, 2
-    cfg = RansacConfig(n_hyps=8, refine_iters=2, polish_iters=1)
+    cfg = RansacConfig(n_hyps=8, refine_iters=2, polish_iters=1,
+                       score_chunk=4)
     keys = jax.random.split(jax.random.key(8), B)
     coords_sel = jnp.stack([
         jnp.stack([coords, coords + 0.1]),
@@ -273,7 +328,7 @@ def _build_routed_scene_serve():
         gating_channels=(2,), compute_dtype="float32", gated=True,
     )
     cfg = RansacConfig(n_hyps=4, refine_iters=1, polish_iters=1,
-                       frame_buckets=(1, 4))
+                       frame_buckets=(1, 4), score_chunk=2)
     # k < M so the traced program is the REAL two-phase routed pipeline
     # (gating -> top-k -> capacity blocks -> scatter -> routed esac), not
     # the K=M dense specialization.
@@ -321,7 +376,8 @@ def _build_registry_scene_serve():
         stem_channels=(2, 2, 2), head_channels=2, head_depth=1,
         gating_channels=(2,), compute_dtype="float32", gated=True,
     )
-    cfg = RansacConfig(n_hyps=4, refine_iters=1, polish_iters=1)
+    cfg = RansacConfig(n_hyps=4, refine_iters=1, polish_iters=1,
+                       score_chunk=2)
     fn = make_scene_bucket_fn(preset, cfg)
 
     from esac_tpu.models.expert import ExpertNet
@@ -407,10 +463,11 @@ def _build_sharded_infer_frames_dynamic():
     from esac_tpu.parallel.mesh import make_mesh
     from esac_tpu.ransac.config import RansacConfig
 
-    coords, pixels, f, c = _geom_inputs()
+    coords, pixels, f, c = _geom_inputs(_INFER_CELLS)
     B, M = 2, 4
     mesh = make_mesh(n_data=2, n_expert=4)
-    cfg = RansacConfig(n_hyps=4, refine_iters=1, polish_iters=1)
+    cfg = RansacConfig(n_hyps=4, refine_iters=1, polish_iters=1,
+                       score_chunk=2)
     infer = make_esac_infer_sharded_frames_dynamic(mesh, cfg)
     coords_all = jnp.stack(
         [coords, coords + 0.1, coords - 0.1, coords + 0.2]
@@ -439,6 +496,22 @@ ENTRIES: tuple[Entry, ...] = (
           note="reference-parity scoring impl"),
     Entry("scoring_fused_grad", pinned=True, build=_build_scoring("fused"),
           note="fused XLA broadcast+reduce scoring impl"),
+    Entry("scoring_fused_select_train_grad", pinned=True,
+          build=_build_scoring("fused_select"),
+          note="fused_select TRAINING scoring path: chunked+remat errmap "
+               "math (soft_inlier_scores_chunked) — all scores for the "
+               "softmax expectation, peak bytes bounded to one "
+               "(score_chunk, n_cells) tile in forward and backward"),
+    Entry("scoring_fused_select_grad", pinned=True,
+          build=_build_scoring_fused_select_grad,
+          note="streamed score+select forward (chunked XLA sibling) + the "
+               "custom_vjp backward that recomputes only the winner's "
+               "score path — nothing errmap-shaped in either direction"),
+    Entry("dsac_infer_fused_select", pinned=True,
+          build=_build_dsac_infer_fused_select,
+          note="full single-frame inference under scoring_impl="
+               "'fused_select': selection fused into the scoring stream, "
+               "no (n_hyps,) score vector in the program at all"),
     Entry("esac_train_loss_dense_grad", pinned=True,
           build=_build_esac_train_grad,
           note="multi-expert dense training loss + backward"),

@@ -39,7 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from esac_tpu.parallel.mesh import shard_map
 from esac_tpu.ransac.config import RansacConfig
-from esac_tpu.ransac.esac import _per_expert_hypotheses, _routed_frame_winner
+from esac_tpu.ransac.esac import _per_expert_winners, _routed_frame_winner
 from esac_tpu.ransac.kernel import _split_score_key
 from esac_tpu.ransac.refine import refine_soft_inliers
 
@@ -116,18 +116,20 @@ def _sharded_infer_fn(mesh: Mesh, cfg: RansacConfig):
         M = m_local * n_exp_shards
         k_hyp, k_sub = _split_score_key(k, cfg)
         k_local = jax.random.fold_in(k_hyp, shard_id)
-        rvecs, tvecs, scores = _per_expert_hypotheses(
+        rvecs, tvecs, best_j, best_s, _ = _per_expert_winners(
             k_local, coords_local, px, f, c, cfg, score_key=k_sub,
-        )  # (m_local, nh, 3), (m_local, nh)
+        )  # (m_local, nh, 3) poses, (m_local,) streamed winners
 
-        # Local winner + full refinement (each device refines one pose).
-        flat = jnp.argmax(scores.reshape(-1))
-        mi, j = flat // scores.shape[1], flat % scores.shape[1]
+        # Local winner + full refinement (each device refines one pose);
+        # the per-expert streamed winners reduce exactly like the old flat
+        # argmax (first-max-wins at every level).
+        mi = jnp.argmax(best_s)
+        j = best_j[mi]
         rvec, tvec = refine_soft_inliers(
             rvecs[mi, j], tvecs[mi, j], coords_local[mi], px, f, c,
             cfg.tau, cfg.beta, iters=cfg.refine_iters,
         )
-        local_score = scores[mi, j]
+        local_score = best_s[mi]
         global_expert = shard_id * m_local + mi
 
         return _winner_allreduce(local_score, global_expert, rvec, tvec, M)
@@ -214,16 +216,16 @@ def make_esac_infer_sharded_frames_dynamic(
             # score-subsample key splits BEFORE the per-shard fold.
             k_hyp, k_sub = _split_score_key(k, cfg)
             k_local = jax.random.fold_in(k_hyp, shard_id)
-            rvecs, tvecs, scores = _per_expert_hypotheses(
+            rvecs, tvecs, best_j, best_s, _ = _per_expert_winners(
                 k_local, coords_m, px, fi, c, cfg, score_key=k_sub,
             )
-            flat = jnp.argmax(scores.reshape(-1))
-            mi, j = flat // scores.shape[1], flat % scores.shape[1]
+            mi = jnp.argmax(best_s)
+            j = best_j[mi]
             rvec, tvec = refine_soft_inliers(
                 rvecs[mi, j], tvecs[mi, j], coords_m[mi], px, fi, c,
                 cfg.tau, cfg.beta, iters=cfg.refine_iters,
             )
-            return rvec, tvec, scores[mi, j], shard_id * m_local + mi
+            return rvec, tvec, best_s[mi], shard_id * m_local + mi
 
         rvec, tvec, local_score, g_expert = jax.vmap(one_frame)(
             batch["key"], coords_local, batch["pixels"], batch["f"]
@@ -567,19 +569,21 @@ def esac_infer_routed(
             )  # (cap, h, w, 3)
             coords_c = coords_c.reshape(cap, -1, 3)
             k_frame = jax.random.fold_in(k_shard, fi)
-            rvecs, tvecs, scores = _per_expert_hypotheses(
+            rvecs, tvecs, best_j, best_s, _ = _per_expert_winners(
                 k_frame, coords_c, px, focal, c_pt, cfg, score_key=k_sub,
-            )  # (cap, nh, 3), (cap, nh)
+            )  # (cap, nh, 3) poses, (cap,) streamed winners
             # Padding slots (a shard with fewer real experts than capacity)
             # must not win on consensus score.
-            scores = jnp.where(is_real[:, None], scores, -jnp.inf)
-            flat = jnp.argmax(scores.reshape(-1))
-            mi, j = flat // scores.shape[1], flat % scores.shape[1]
+            best_s = jnp.where(is_real, best_s, -jnp.inf)
+            mi = jnp.argmax(best_s)
+            # All-padding shard: match the flat argmax over an all -inf
+            # matrix, which lands on (0, 0).
+            j = jnp.where(is_real[mi], best_j[mi], 0)
             rvec, tvec = refine_soft_inliers(
                 rvecs[mi, j], tvecs[mi, j], coords_c[mi], px, focal, c_pt,
                 cfg.tau, cfg.beta, iters=cfg.refine_iters,
             )
-            return (rvec, tvec, scores[mi, j],
+            return (rvec, tvec, best_s[mi],
                     shard_id * m_local + top_local[mi],
                     shard_id * m_local + top_local)
 

@@ -11,6 +11,10 @@ from __future__ import annotations
 
 import dataclasses
 
+# The valid RansacConfig.scoring_impl values — the single source the
+# config validator and the kernel dispatches share.
+SCORING_IMPLS = ("errmap", "fused", "pallas", "fused_select")
+
 
 @dataclasses.dataclass(frozen=True)
 class RansacConfig:
@@ -51,13 +55,28 @@ class RansacConfig:
     # cells; keep 0 for strict parity.
     score_cells: int = 0
     # Scoring implementation:
-    #   "errmap"     — reprojection_error_map (hmm matmul) + sigmoid-sum; the
-    #                  reference-parity formulation, materializes (H, N, 3)
-    #                  transformed points through the dot.
-    #   "fused"      — one fused XLA broadcast+reduce program, f32
-    #                  (pallas_scoring.soft_inlier_scores_fused): no
-    #                  intermediate map in HBM, plain autodiff.
-    #   "pallas"     — the hand-written Pallas VMEM kernel (custom_vjp).
+    #   "errmap"       — reprojection_error_map (hmm matmul) + sigmoid-sum;
+    #                    the reference-parity formulation, materializes
+    #                    (H, N, 3) transformed points through the dot.
+    #   "fused"        — one fused XLA broadcast+reduce program, f32
+    #                    (pallas_scoring.soft_inlier_scores_fused): no
+    #                    intermediate map in HBM, plain autodiff.
+    #   "pallas"       — the hand-written Pallas VMEM kernel (custom_vjp).
+    #   "fused_select" — fused score+SELECT: inference entry points stream
+    #                    hypotheses through selection and never materialize
+    #                    even the (H,) score vector (outputs carry the
+    #                    winner's 'score' instead of 'scores').  On TPU the
+    #                    Pallas VMEM select kernel runs; elsewhere the
+    #                    chunked XLA sibling, whose winner is bit-identical
+    #                    to the errmap argmax (ties included).  The TRAINING
+    #                    path still needs every score for the softmax
+    #                    expectation, so it runs the chunked+remat errmap
+    #                    math (soft_inlier_scores_chunked): same numbers,
+    #                    peak bytes bounded to one score_chunk tile.
+    # NOTE: whatever the impl, inference-path scoring is CHUNKED over
+    # hypothesis tiles (score_chunk) since ISSUE 8 — the full errmap never
+    # materializes on any inference entry point; "errmap"/"fused" keep
+    # their bit-identical (H,) scores output, materialized tile by tile.
     # A bf16 variant of "fused" was tried and REJECTED: bf16 ULP on rotation
     # entries (~4e-3) shifts every projected cell of a hypothesis by ~2 px
     # systematically, and the correlated sigmoid shifts summed over thousands
@@ -66,9 +85,19 @@ class RansacConfig:
     # Default is decided by the hardware A/B (tools/pallas_ab.py); "errmap"
     # until a measured win is recorded in .pallas_ab.json.
     scoring_impl: str = "errmap"
-    # Back-compat alias: True forces scoring_impl="pallas" (kept so round-1
-    # call sites and the A/B harness keep working).
+    # DEPRECATED back-compat alias: True is resolved to
+    # scoring_impl="pallas" (and the flag reset to False) in __post_init__ —
+    # the ONE normalization point, so kernels read only scoring_impl and the
+    # two spellings hash to the same static-arg config.  Prefer
+    # scoring_impl="pallas"; this field will eventually go away.
     use_pallas_scoring: bool = False
+    # Hypothesis-tile size for chunked/streamed scoring+selection: the
+    # largest live scoring intermediate on inference entries (and the
+    # fused_select training path) is (score_chunk, n_cells) instead of
+    # (n_hyps, n_cells).  Per-hypothesis numbers are tile-size-invariant
+    # (independent reductions), so this knob trades scan trip count against
+    # peak bytes without touching results.  Clamped to n_hyps.
+    score_chunk: int = 64
     # Differentiate the training expectation through the per-hypothesis
     # refined pose losses (autodiff-through-IRLS — the jax replacement for
     # the reference's central-difference machinery).  False restricts the
@@ -117,3 +146,16 @@ class RansacConfig:
     # change which (frame, expert) pairs survive and break the
     # bucket-invariance contract (see ransac.esac.routed_serve_capacity).
     serve_capacity: int = 0
+
+    def __post_init__(self):
+        # The ONE resolution point for the deprecated use_pallas_scoring
+        # alias: fold it into scoring_impl so no call site re-derives the
+        # dispatch (and both spellings hash identically as static args).
+        if self.use_pallas_scoring:
+            object.__setattr__(self, "scoring_impl", "pallas")
+            object.__setattr__(self, "use_pallas_scoring", False)
+        if self.scoring_impl not in SCORING_IMPLS:
+            raise ValueError(
+                f"unknown RansacConfig.scoring_impl: {self.scoring_impl!r} "
+                f"(valid: {SCORING_IMPLS})"
+            )

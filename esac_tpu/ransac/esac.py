@@ -34,6 +34,7 @@ import jax.numpy as jnp
 
 from esac_tpu.ransac.config import RansacConfig
 from esac_tpu.ransac.kernel import (
+    _infer_winner,
     _score_hypotheses,
     _split_score_key,
     generate_hypotheses,
@@ -75,6 +76,42 @@ def _per_expert_hypotheses(key, coords_all, pixels, f, c, cfg,
     return rvecs, tvecs, scores
 
 
+def _per_expert_winners(key, coords_all, pixels, f, c, cfg,
+                        score_key=None, idx=None):
+    """Inference sibling of :func:`_per_expert_hypotheses`: generate
+    cfg.n_hyps hypotheses per expert, then STREAM scoring+selection per
+    expert (``kernel._infer_winner``) instead of materializing the errmap.
+
+    Returns ``(rvecs, tvecs, best_j, best_s, scores)``: poses (M, n_hyps,
+    3), per-expert winner index/score (M,), and the (M, n_hyps) score
+    matrix — None exactly when cfg.scoring_impl == "fused_select" (full
+    fusion: only the winners exist).  The global winner is
+    ``m* = argmax(best_s)``, ``j* = best_j[m*]`` — bit-identical to the
+    flat argmax over (M * n_hyps) scores, ties included: within an expert
+    the stream keeps the first max, across experts ``jnp.argmax`` on
+    (M,) keeps the first expert attaining the max.  Key discipline as in
+    ``_per_expert_hypotheses`` (shared score-subsample key).
+    """
+    M = coords_all.shape[0]
+    if score_key is None:
+        key, k_sub = _split_score_key(key, cfg)
+    else:
+        k_sub = score_key
+    keys = jax.random.split(key, M)
+    if idx is None:
+        rvecs, tvecs = jax.vmap(
+            lambda k, co: generate_hypotheses(k, co, pixels, f, c, cfg)
+        )(keys, coords_all)
+    else:
+        rvecs, tvecs = jax.vmap(
+            lambda k, co, ix: generate_hypotheses(k, co, pixels, f, c, cfg, idx=ix)
+        )(keys, coords_all, idx)
+    best_j, best_s, scores = jax.vmap(
+        lambda rv, tv, co: _infer_winner(k_sub, rv, tv, co, pixels, f, c, cfg)
+    )(rvecs, tvecs, coords_all)
+    return rvecs, tvecs, best_j, best_s, scores
+
+
 @partial(jax.jit, static_argnames=("cfg",))
 def esac_infer(
     key: jax.Array,
@@ -93,14 +130,15 @@ def esac_infer(
     which strictly dominates the reference's drawn-subset argmax.
 
     Returns dict with 'rvec', 'tvec', 'expert' (winning expert index),
-    'scores' (M, n_hyps), 'gating_probs'.
+    'gating_probs', 'inlier_frac', and 'scores' (M, n_hyps) — except under
+    scoring_impl="fused_select", where scoring streams through selection
+    and the winner's scalar 'score' is returned instead.
     """
-    rvecs, tvecs, scores = _per_expert_hypotheses(
+    rvecs, tvecs, best_j, best_s, scores = _per_expert_winners(
         key, coords_all, pixels, f, c, cfg
     )
-    M, nh = scores.shape
-    flat = jnp.argmax(scores.reshape(-1))
-    m_star, j_star = flat // nh, flat % nh
+    m_star = jnp.argmax(best_s)
+    j_star = best_j[m_star]
     rvec, tvec = refine_soft_inliers(
         rvecs[m_star, j_star],
         tvecs[m_star, j_star],
@@ -112,14 +150,18 @@ def esac_infer(
         cfg.beta,
         iters=cfg.refine_iters,
     )
-    return {
+    out = {
         "rvec": rvec,
         "tvec": tvec,
         "expert": m_star,
-        "scores": scores,
         "gating_probs": jax.nn.softmax(gating_logits),
-        "inlier_frac": scores[m_star, j_star] / pixels.shape[0],
+        "inlier_frac": best_s[m_star] / pixels.shape[0],
     }
+    if scores is None:
+        out["score"] = best_s[m_star]
+    else:
+        out["scores"] = scores
+    return out
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -201,8 +243,10 @@ def esac_infer_topk(
         "expert": top[out["expert"]],
         "experts_evaluated": top,
         # Full M-way distribution, matching esac_infer — NOT renormalized
-        # over the pruned subset.  Note 'scores' stays (k, n_hyps): rows
-        # align with 'experts_evaluated', not with expert index.
+        # over the pruned subset.  Note 'scores' (absent under
+        # scoring_impl="fused_select", which streams the winner) stays
+        # (k, n_hyps): rows align with 'experts_evaluated', not with
+        # expert index.
         "gating_probs": jax.nn.softmax(gating_logits),
     }
 
@@ -268,35 +312,44 @@ def routed_serve_capacity(cfg: RansacConfig, k: int, num_experts: int) -> int:
 
 def _routed_frame_winner(key, co_sel, sel, live, px, fi, c, cfg_k, M):
     """One frame of the capacity-routed hypothesis loop: global-index RNG
-    streams, generate + score over the K gathered expert maps, ``-inf``
-    masking of non-live slots, flat argmax, winner-only refine.
+    streams, generate + STREAMED score+select over the K gathered expert
+    maps (``kernel._infer_winner`` per slot), ``-inf`` masking of non-live
+    slots at the slot level, winner-only refine.
 
     Shared VERBATIM by :func:`esac_infer_routed_frames` and
     ``parallel.make_esac_infer_routed_frames_sharded`` so their bit-level
     agreement on evaluated pairs is structural, not merely pinned by the
     (slow) cross-path test.  ``cfg_k`` is the budget-reallocated config;
     returns ``(rvec, tvec, scores, mi, best)`` — refined winner pose,
-    masked (K, nh) scores, winning slot index, winning score.
+    masked (K, nh) scores (None under scoring_impl="fused_select"),
+    winning slot index, winning score.
+
+    Selection is bit-identical to the old flat argmax over the masked
+    (K, nh) matrix: a live slot's streamed winner is its row's first max,
+    ``jnp.argmax`` over per-slot winners keeps the first slot on ties, and
+    a frame whose every slot dropped resolves to (mi=0, j=0) exactly as
+    ``argmax`` over an all ``-inf`` matrix does.
     """
     k_hyp, k_sub = _split_score_key(key, cfg_k)
     keys_sel = jax.random.split(k_hyp, M)[sel]  # global-index streams
     rvecs, tvecs = jax.vmap(
         lambda kk, co: generate_hypotheses(kk, co, px, fi, c, cfg_k)
     )(keys_sel, co_sel)
-    scores = jax.vmap(
-        lambda rv, tv, co: _score_hypotheses(
-            k_sub, rv, tv, co, px, fi, c, cfg_k
-        )
+    best_j, best_s, scores = jax.vmap(
+        lambda rv, tv, co: _infer_winner(k_sub, rv, tv, co, px, fi, c, cfg_k)
     )(rvecs, tvecs, co_sel)
-    scores = jnp.where(live[:, None], scores, -jnp.inf)
-    nh = scores.shape[1]
-    flat = jnp.argmax(scores.reshape(-1))
-    mi, j = flat // nh, flat % nh
+    best_s = jnp.where(live, best_s, -jnp.inf)
+    if scores is not None:
+        scores = jnp.where(live[:, None], scores, -jnp.inf)
+    mi = jnp.argmax(best_s)
+    # All-dropped frame: every masked winner is -inf and argmax lands on
+    # slot 0; pin j to 0 to match the flat-argmax failure output.
+    j = jnp.where(live[mi], best_j[mi], 0)
     rvec, tvec = refine_soft_inliers(
         rvecs[mi, j], tvecs[mi, j], co_sel[mi], px, fi, c,
         cfg_k.tau, cfg_k.beta, iters=cfg_k.refine_iters,
     )
-    return rvec, tvec, scores, mi, scores[mi, j]
+    return rvec, tvec, scores, mi, best_s[mi]
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -354,15 +407,21 @@ def esac_infer_routed_frames(
         rvec, tvec, scores, mi, best = _routed_frame_winner(
             key, co_sel, sel, kp, px, fi, c, cfg_k, M
         )
-        return {
+        out = {
             "rvec": rvec,
             "tvec": tvec,
             "expert": sel[mi],
-            "scores": scores,
             "experts_evaluated": jnp.where(kp, sel, M).astype(jnp.int32),
             "gating_probs": jax.nn.softmax(logits),
             "inlier_frac": best / px.shape[0],
         }
+        # Full fusion (scoring_impl="fused_select"): only the winner's
+        # score exists; otherwise the masked (K, nh) matrix rides along.
+        if scores is None:
+            out["score"] = best
+        else:
+            out["scores"] = scores
+        return out
 
     return jax.vmap(one_frame)(
         keys, gating_logits, coords_sel, selected, kept, pixels, f

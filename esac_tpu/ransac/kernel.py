@@ -35,25 +35,27 @@ from esac_tpu.ransac.scoring import (
 
 
 def _score_hypotheses(key, rvecs, tvecs, coords, pixels, f, c, cfg):
-    """Soft-inlier scores, optionally on a cell subsample (cfg.score_cells).
+    """ALL-hypotheses soft-inlier scores, optionally on a cell subsample
+    (cfg.score_cells) — the TRAINING-path scoring entry (the softmax
+    expectation needs every score).  The ESAC multi-expert training path
+    calls this too, so scale corrections stay in one place.
 
-    The single source of truth for hypothesis scoring — the ESAC multi-expert
-    path calls this too, so scale corrections stay in one place.
+    Implementation is selected by cfg.scoring_impl (see RansacConfig; the
+    deprecated use_pallas_scoring flag is resolved into it by
+    ``RansacConfig.__post_init__``, never re-derived here) — every impl is
+    differentiable.  "fused_select" runs the chunked+remat errmap math
+    (``soft_inlier_scores_chunked``): numbers bit-identical to "errmap",
+    peak live bytes bounded to one (score_chunk, n_cells) tile in forward
+    AND backward.
 
-    Implementation is selected by cfg.scoring_impl ("errmap" | "fused" |
-    "pallas"; see RansacConfig) — all three are differentiable, so training
-    and inference both honor it.  cfg.use_pallas_scoring=True is the
-    back-compat override forcing "pallas" (custom_vjp with an analytic XLA
-    backward mirroring the kernel math).
-
-    HBM note: the "errmap" path materializes the full (n_hyps, n_cells)
-    reprojection-error map that the selection argmax immediately consumes —
-    B*M*n_hyps*n_cells*4 bytes per dispatch, the committed number in
-    .jaxpr_ledger.json (entries esac_infer_frames / scoring_errmap_grad,
-    graft-audit v2) and the DESIGN.md §9 / ROADMAP item 3 fusion target.
+    HBM note: the "errmap"/"fused" TRAINING paths still materialize the
+    full (n_hyps, n_cells) reprojection-error map — the committed number in
+    .jaxpr_ledger.json (scoring_errmap_grad).  INFERENCE entry points no
+    longer call this: they stream scoring+selection through
+    :func:`_infer_winner`, which never materializes the errmap.
     """
     coords_s, pixels_s, scale = subsample_cells(key, coords, pixels, cfg.score_cells)
-    impl = "pallas" if cfg.use_pallas_scoring else cfg.scoring_impl
+    impl = cfg.scoring_impl
     if impl == "pallas":
         from esac_tpu.ransac.pallas_scoring import soft_inlier_scores_pallas
 
@@ -69,10 +71,70 @@ def _score_hypotheses(key, rvecs, tvecs, coords, pixels, f, c, cfg):
             rodrigues(rvecs), tvecs, coords_s, pixels_s, f, c,
             cfg.tau, cfg.beta,
         ) * scale
+    if impl == "fused_select":
+        from esac_tpu.ransac.pallas_scoring import soft_inlier_scores_chunked
+
+        return soft_inlier_scores_chunked(
+            rvecs, tvecs, coords_s, pixels_s, f, c, cfg.tau, cfg.beta,
+            impl="errmap", chunk=cfg.score_chunk,
+        ) * scale
     if impl != "errmap":
         raise ValueError(f"unknown RansacConfig.scoring_impl: {impl!r}")
     errors = reprojection_error_map(rvecs, tvecs, coords_s, pixels_s, f, c)
     return soft_inlier_score(errors, cfg.tau, cfg.beta) * scale
+
+
+def _infer_winner(key, rvecs, tvecs, coords, pixels, f, c, cfg):
+    """Streaming score+select — the structure of EVERY inference entry
+    point (ROADMAP item 3): hypotheses tile through scoring in
+    (cfg.score_chunk, n_cells) chunks, so the (n_hyps, n_cells) errmap the
+    argmax used to consume never materializes on any inference path.
+
+    Returns ``(best, best_score, scores)``:
+
+    - "errmap" / "fused": chunked scoring (per-hypothesis numbers
+      bit-identical to the materializing formulation) still yields the full
+      (n_hyps,) ``scores`` vector — n_hyps*4 bytes, NOT the errmap term —
+      so result dicts keep their 'scores' field and every committed
+      bit-parity pin survives unchanged.
+    - "pallas": the fused scoring kernel (already errmap-free), argmax on
+      its (n_hyps,) output.
+    - "fused_select": full fusion — selection happens inside the stream
+      (Pallas VMEM kernel on TPU, chunked XLA sibling elsewhere) and
+      ``scores`` is None; only the winner's index and score exist.
+      Winner tie-breaking matches ``jnp.argmax`` bit-for-bit.
+    """
+    coords_s, pixels_s, scale = subsample_cells(key, coords, pixels, cfg.score_cells)
+    impl = cfg.scoring_impl
+    if impl == "fused_select":
+        from esac_tpu.ransac.pallas_scoring import soft_inlier_score_select
+
+        best, best_score = soft_inlier_score_select(
+            rodrigues(rvecs), tvecs, coords_s, pixels_s, f, c,
+            cfg.tau, cfg.beta,
+            use_pallas=jax.default_backend() == "tpu",
+            chunk=cfg.score_chunk,
+        )
+        return best, best_score * scale, None
+    if impl == "pallas":
+        from esac_tpu.ransac.pallas_scoring import soft_inlier_scores_pallas
+
+        scores = soft_inlier_scores_pallas(
+            rodrigues(rvecs), tvecs, coords_s, pixels_s, f, c,
+            cfg.tau, cfg.beta,
+            interpret=jax.default_backend() != "tpu",
+        ) * scale
+    elif impl in ("errmap", "fused"):
+        from esac_tpu.ransac.pallas_scoring import soft_inlier_scores_chunked
+
+        scores = soft_inlier_scores_chunked(
+            rvecs, tvecs, coords_s, pixels_s, f, c, cfg.tau, cfg.beta,
+            impl=impl, chunk=cfg.score_chunk,
+        ) * scale
+    else:
+        raise ValueError(f"unknown RansacConfig.scoring_impl: {impl!r}")
+    best = jnp.argmax(scores)
+    return best, scores[best], scores
 
 
 def _split_score_key(key, cfg):
@@ -137,15 +199,20 @@ def dsac_infer(
     c: jnp.ndarray,
     cfg: RansacConfig = RansacConfig(),
 ) -> dict:
-    """Inference: argmax-select the best-scoring hypothesis, refine it fully.
+    """Inference: stream-select the best-scoring hypothesis, refine it
+    fully.  Scoring+selection go through :func:`_infer_winner`, so the
+    (n_hyps, n_cells) errmap never materializes.
 
-    Returns dict with 'rvec', 'tvec' (the refined winner), 'scores'
-    (n_hyps,), 'best' (index), 'inlier_frac' of the winner.
+    Returns dict with 'rvec', 'tvec' (the refined winner), 'best' (index),
+    'inlier_frac' of the winner, and 'scores' (n_hyps,) — except under
+    scoring_impl="fused_select", where the score vector itself is fused
+    away and the winner's scalar 'score' is returned instead.
     """
     key, k_sub = _split_score_key(key, cfg)
     rvecs, tvecs = generate_hypotheses(key, coords, pixels, f, c, cfg)
-    scores = _score_hypotheses(k_sub, rvecs, tvecs, coords, pixels, f, c, cfg)
-    best = jnp.argmax(scores)
+    best, best_score, scores = _infer_winner(
+        k_sub, rvecs, tvecs, coords, pixels, f, c, cfg
+    )
     rvec, tvec = refine_soft_inliers(
         rvecs[best],
         tvecs[best],
@@ -158,13 +225,17 @@ def dsac_infer(
         iters=cfg.refine_iters,
     )
     n_cells = coords.shape[0]
-    return {
+    out = {
         "rvec": rvec,
         "tvec": tvec,
-        "scores": scores,
         "best": best,
-        "inlier_frac": scores[best] / n_cells,
+        "inlier_frac": best_score / n_cells,
     }
+    if scores is None:
+        out["score"] = best_score
+    else:
+        out["scores"] = scores
+    return out
 
 
 @partial(jax.jit, static_argnames=("cfg",))

@@ -27,6 +27,13 @@ broadcast products (``_scores_xla_mirror``) and differentiates it — the
 scoring backward is itself one fused elementwise+reduce XLA program, so a
 hand-written backward kernel would save only the recompute, not a second
 HBM round trip.  Training paths may therefore enable the kernel too.
+
+ISSUE 8 adds the streaming SELECTION layer on top: a fused score+select
+kernel (``soft_inlier_score_select`` / ``_score_select_kernel``) that
+never writes even the (H,) score vector to HBM, its chunked XLA sibling
+(bit-identical to the errmap argmax, CPU-measurable today), and the
+chunked all-scores variant (``soft_inlier_scores_chunked``) that bounds
+the training path's peak bytes to one hypothesis tile.
 """
 
 from __future__ import annotations
@@ -38,19 +45,21 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from esac_tpu.geometry.camera import MIN_DEPTH
+from esac_tpu.geometry.camera import MIN_DEPTH, reprojection_errors
+from esac_tpu.ransac.scoring import reprojection_error_map, soft_inlier_score
 
 HYP_BLOCK = 8
 CELL_BLOCK = 512
 
 
-def _score_kernel(scal_ref, pose_ref, coords_ref, pixels_ref, out_ref):
-    """One (hyp-block, cell-block) tile of fused transform+project+score.
+def _tile_partial_scores(scal_ref, pose_ref, coords_ref, pixels_ref):
+    """One (hyp-block, cell-block) tile of fused transform+project+score:
+    the shared VPU body of the scoring and score+select kernels.
 
     scal_ref: (5, 1) SMEM — f, cx, cy, tau, beta.
     pose_ref: (HYP_BLOCK, 12) VMEM — rows [R00..R22, t0, t1, t2].
     coords_ref: (3, CELL_BLOCK) VMEM;  pixels_ref: (2, CELL_BLOCK) VMEM.
-    out_ref: (HYP_BLOCK, 1) VMEM — accumulated over the cell grid dim.
+    Returns (HYP_BLOCK, 1) partial soft-inlier scores for this cell block.
     """
     f = scal_ref[0, 0]
     cx = scal_ref[1, 0]
@@ -77,9 +86,17 @@ def _score_kernel(scal_ref, pose_ref, coords_ref, pixels_ref, out_ref):
     dv = f * Yy / z + cy - py
     err = jnp.sqrt(du * du + dv * dv + 1e-12)
     err = jnp.where(Yz < MIN_DEPTH, err + 1000.0, err)
-    partial_scores = jnp.sum(
+    return jnp.sum(
         jax.nn.sigmoid(beta * (tau - err)), axis=1, keepdims=True
     )  # (H, 1)
+
+
+def _score_kernel(scal_ref, pose_ref, coords_ref, pixels_ref, out_ref):
+    """Scoring-only kernel: accumulate tile scores over the cell grid dim
+    into out_ref (HYP_BLOCK, 1)."""
+    partial_scores = _tile_partial_scores(
+        scal_ref, pose_ref, coords_ref, pixels_ref
+    )
 
     j = pl.program_id(1)
 
@@ -102,6 +119,34 @@ def _pad_to(x: jnp.ndarray, axis: int, multiple: int, value: float) -> jnp.ndarr
     return jnp.pad(x, pad, constant_values=value)
 
 
+def _stage_pallas_inputs(Rs, ts, coords, pixels, f, c, tau, beta):
+    """Pack poses/coords/pixels/scalars into the kernels' padded VMEM/SMEM
+    layout.  Returns (poses (Hp, 12), coords_t (3, Np), pixels_t (2, Np),
+    scalars (5, 1)).
+
+    Padding cells are placed far behind the camera (err ~ 2000 px), so their
+    sigmoid contribution underflows to exactly 0 and no correction is
+    needed; padded (all-zero) poses give z = 0 < MIN_DEPTH -> the +1000 px
+    branch -> score exactly 0 (callers slice or mask them off).
+    """
+    H = Rs.shape[0]
+    poses = jnp.concatenate(
+        [Rs.reshape(H, 9), ts.reshape(H, 3)], axis=1
+    ).astype(jnp.float32)
+    poses = _pad_to(poses, 0, HYP_BLOCK, 0.0)
+
+    coords_t = coords.T.astype(jnp.float32)  # (3, N)
+    pixels_t = pixels.T.astype(jnp.float32)  # (2, N)
+    coords_t = _pad_to(coords_t, 1, CELL_BLOCK, 0.0)
+    pixels_t = _pad_to(pixels_t, 1, CELL_BLOCK, 1e6)
+
+    scalars = jnp.stack(
+        [jnp.float32(f), c[0].astype(jnp.float32), c[1].astype(jnp.float32),
+         jnp.float32(tau), jnp.float32(beta)]
+    ).reshape(5, 1)
+    return poses, coords_t, pixels_t, scalars
+
+
 def _scores_pallas_raw(
     Rs: jnp.ndarray,
     ts: jnp.ndarray,
@@ -116,30 +161,15 @@ def _scores_pallas_raw(
     """Fused soft-inlier scores. Rs: (H, 3, 3), ts: (H, 3), coords: (N, 3),
     pixels: (N, 2).  Returns (H,) float32 scores.
 
-    Padding cells are placed far behind the camera (err ~ 2000 px), so their
-    sigmoid contribution underflows to exactly 0 and no correction is needed;
-    padded hypotheses are sliced off the result.
+    Padding semantics: see :func:`_stage_pallas_inputs` (padded cells score
+    exactly 0; padded hypotheses are sliced off the result).
     """
     H = Rs.shape[0]
-    poses = jnp.concatenate(
-        [Rs.reshape(H, 9), ts.reshape(H, 3)], axis=1
-    ).astype(jnp.float32)
-    poses = _pad_to(poses, 0, HYP_BLOCK, 0.0)
-
-    coords_t = coords.T.astype(jnp.float32)  # (3, N)
-    pixels_t = pixels.T.astype(jnp.float32)  # (2, N)
-    # Pad coordinates with a point far behind any camera: Y = R*X + t with
-    # X = 0 and identity-ish padding poses gives z = 0 < MIN_DEPTH -> the
-    # +1000 px branch -> sigmoid(beta*(tau - ~1000)) == 0 in f32.
-    coords_t = _pad_to(coords_t, 1, CELL_BLOCK, 0.0)
-    pixels_t = _pad_to(pixels_t, 1, CELL_BLOCK, 1e6)
+    poses, coords_t, pixels_t, scalars = _stage_pallas_inputs(
+        Rs, ts, coords, pixels, f, c, tau, beta
+    )
     Hp = poses.shape[0]
     Np = coords_t.shape[1]
-
-    scalars = jnp.stack(
-        [jnp.float32(f), c[0].astype(jnp.float32), c[1].astype(jnp.float32),
-         jnp.float32(tau), jnp.float32(beta)]
-    ).reshape(5, 1)
 
     grid = (Hp // HYP_BLOCK, Np // CELL_BLOCK)
     out = pl.pallas_call(
@@ -251,3 +281,307 @@ def soft_inlier_scores_pallas(
     return _scores_pallas_vjp(Rs, ts, coords, pixels,
                               jnp.float32(f), jnp.asarray(c, jnp.float32),
                               tau, beta, interpret)
+
+
+# --------------------------------------------------------------------------
+# Fused score+select: stream hypotheses through selection (ROADMAP item 3).
+#
+# The errmap — and even the (H,) score vector — never round-trips through
+# HBM: hypothesis blocks tile through VMEM carrying a running (max score,
+# argmax index, winner pose) accumulator.  Selection tie-breaking matches
+# ``jnp.argmax`` bit-for-bit: within a block the FIRST max wins (index-min
+# over the block's maxima), across blocks only a strictly greater score
+# displaces the running winner, and blocks are visited in index order
+# (TPU grids are sequential).
+
+# Index sentinel for the within-block tie-break min (far above any H).
+_IDX_INF = 2 ** 30
+
+
+def _score_select_kernel(scal_ref, nhyp_ref, pose_ref, coords_ref,
+                         pixels_ref, best_score_ref, best_idx_ref,
+                         best_pose_ref, acc_ref):
+    """Fused score+select: accumulate each hyp block's scores over the cell
+    grid dim in VMEM scratch, then fold the completed block into the
+    running (max score, argmax index, winner pose) outputs.
+
+    nhyp_ref: (1, 1) SMEM int32 — the REAL hypothesis count H (padded rows
+    beyond it can never win).  best_score_ref (1, 1) f32, best_idx_ref
+    (1, 1) int32, best_pose_ref (1, 12) f32: revisited every grid step
+    (constant index_map), so they act as the cross-block accumulator.
+    """
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    partial_scores = _tile_partial_scores(
+        scal_ref, pose_ref, coords_ref, pixels_ref
+    )
+
+    @pl.when(jnp.logical_and(i == 0, j == 0))
+    def _init_running():
+        best_score_ref[0, 0] = jnp.float32(-jnp.inf)
+        best_idx_ref[0, 0] = jnp.int32(0)
+        best_pose_ref[:] = jnp.zeros_like(best_pose_ref)
+
+    @pl.when(j == 0)
+    def _init_acc():
+        acc_ref[:] = partial_scores
+
+    @pl.when(j > 0)
+    def _acc():
+        acc_ref[:] = acc_ref[:] + partial_scores
+
+    @pl.when(j == nj - 1)
+    def _fold_block():
+        gidx = i * HYP_BLOCK + jax.lax.broadcasted_iota(
+            jnp.int32, (HYP_BLOCK, 1), 0
+        )
+        valid = gidx < nhyp_ref[0, 0]
+        s = jnp.where(valid, acc_ref[:], -jnp.inf)  # (HYP_BLOCK, 1)
+        bmax = jnp.max(s)
+        # First max wins inside the block (jnp.argmax contract).
+        bidx = jnp.min(jnp.where(s == bmax, gidx, jnp.int32(_IDX_INF)))
+        bpose = jnp.sum(
+            jnp.where(gidx == bidx, pose_ref[:], 0.0),
+            axis=0, keepdims=True,
+        )  # (1, 12)
+
+        # Strictly greater only: an equal later block never displaces the
+        # earlier winner.  Block 0 always wins over the -inf init (every
+        # kernel call has >= 1 real hypothesis in block 0).
+        @pl.when(bmax > best_score_ref[0, 0])
+        def _update():
+            best_score_ref[0, 0] = bmax
+            best_idx_ref[0, 0] = bidx
+            best_pose_ref[:] = bpose
+
+
+def _select_pallas_raw(
+    Rs: jnp.ndarray,
+    ts: jnp.ndarray,
+    coords: jnp.ndarray,
+    pixels: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    tau: float,
+    beta: float,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fused score+select over all hypotheses.  Shapes as in
+    ``_scores_pallas_raw``; returns (best_idx () int32, best_score () f32,
+    best_pose (12,) f32 — the winner's packed [R | t] row, bit-identical
+    to the input row it was copied from)."""
+    H = Rs.shape[0]
+    poses, coords_t, pixels_t, scalars = _stage_pallas_inputs(
+        Rs, ts, coords, pixels, f, c, tau, beta
+    )
+    Hp = poses.shape[0]
+    Np = coords_t.shape[1]
+    nhyp = jnp.full((1, 1), H, jnp.int32)
+
+    grid = (Hp // HYP_BLOCK, Np // CELL_BLOCK)
+    best_score, best_idx, best_pose = pl.pallas_call(
+        _score_select_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((5, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((HYP_BLOCK, 12), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, CELL_BLOCK), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, CELL_BLOCK), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 12), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+            jax.ShapeDtypeStruct((1, 12), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((HYP_BLOCK, 1), jnp.float32)],
+        interpret=interpret,
+    )(scalars, nhyp, poses, coords_t, pixels_t)
+    return best_idx[0, 0], best_score[0, 0], best_pose[0]
+
+
+def _hyp_tiles(chunk: int, *arrays):
+    """Pad the shared leading (hypothesis) axis to a multiple of
+    ``min(chunk, H)`` with zeros and reshape each array to
+    (n_tiles, tile, ...).  Returns (tile, [tiled arrays]).
+
+    Padded rows are finite GARBAGE, not guaranteed-zero scores: for
+    rotation-matrix callers a zero R gives the behind-camera penalty
+    (score exactly 0), but for axis-angle callers rodrigues(0) is the
+    IDENTITY rotation and the padded row scores whatever X at t=0
+    projects to.  Every caller must therefore mask padded indices out of
+    selection (``gidx < H``) or slice the stacked result to ``[:H]`` —
+    never reduce over the padded axis directly."""
+    H = arrays[0].shape[0]
+    T = int(max(1, min(chunk, H)))
+    rem = (-H) % T
+    out = []
+    for a in arrays:
+        if rem:
+            a = jnp.concatenate(
+                [a, jnp.zeros((rem,) + a.shape[1:], a.dtype)], axis=0
+            )
+        out.append(a.reshape((a.shape[0] // T, T) + a.shape[1:]))
+    return T, out
+
+
+def _select_chunked_raw(Rs, ts, coords, pixels, f, c, tau, beta, chunk):
+    """Streaming score+select in plain XLA — the CPU-measurable sibling of
+    the Pallas kernel: ``lax.scan`` over hypothesis tiles of the ERRMAP
+    formulation (``reprojection_errors`` + sigmoid-sum, so per-hypothesis
+    scores are bit-identical to the materializing "errmap" impl), carrying
+    a running (max score, argmax index).  Tie-breaking matches
+    ``jnp.argmax`` bit-for-bit: within a tile ``jnp.argmax`` picks the
+    first max; across tiles only strictly-greater displaces.  Returns
+    (best_idx () int32, best_score () f32)."""
+    H = Rs.shape[0]
+    T, (R_tiles, t_tiles) = _hyp_tiles(chunk, Rs, ts)
+
+    def tile_scores(R_tile, t_tile):
+        errs = jax.vmap(
+            lambda R, t: reprojection_errors(R, t, coords, pixels, f, c)
+        )(R_tile, t_tile)
+        return soft_inlier_score(errs, tau, beta)
+
+    def step(carry, xs):
+        best_s, best_i, off = carry
+        s = tile_scores(*xs)
+        gidx = off + jnp.arange(T, dtype=jnp.int32)
+        s = jnp.where(gidx < H, s, -jnp.inf)
+        ti = jnp.argmax(s)
+        take = s[ti] > best_s
+        return (
+            jnp.where(take, s[ti], best_s),
+            jnp.where(take, gidx[ti], best_i),
+            off + T,
+        ), None
+
+    init = (jnp.float32(-jnp.inf), jnp.int32(0), jnp.int32(0))
+    (best_s, best_i, _), _ = jax.lax.scan(step, init, (R_tiles, t_tiles))
+    return best_i, best_s
+
+
+def soft_inlier_scores_chunked(rvecs, tvecs, coords, pixels, f, c, tau,
+                               beta, impl: str = "errmap",
+                               chunk: int = 64) -> jnp.ndarray:
+    """All-hypotheses scores with the hypothesis axis tiled through a
+    ``lax.scan``: per-hypothesis numbers bit-identical to the materializing
+    ``impl`` ("errmap" | "fused") — each hypothesis's score is an
+    independent reduction over cells, so tiling the batch axis changes no
+    arithmetic — while the largest live intermediate is one
+    (tile, n_cells) error tile instead of the full errmap.  Each tile is
+    ``jax.checkpoint``'d so the BACKWARD pass recomputes tiles too instead
+    of stacking per-step residuals back up to errmap size (the training
+    path's bounded-peak-bytes contract under scoring_impl="fused_select").
+
+    Takes axis-angle ``rvecs`` like the errmap path (rodrigues applied
+    per tile is bit-identical to applying it to the full array — it is
+    elementwise per hypothesis).  Returns (H,) scores.
+    """
+    H = rvecs.shape[0]
+    _, (rv_tiles, tv_tiles) = _hyp_tiles(chunk, rvecs, tvecs)
+
+    def tile_scores(rv, tv):
+        if impl == "fused":
+            from esac_tpu.geometry.rotations import rodrigues
+
+            return soft_inlier_scores_fused(
+                rodrigues(rv), tv, coords, pixels, f, c, tau, beta
+            )
+        errs = reprojection_error_map(rv, tv, coords, pixels, f, c)
+        return soft_inlier_score(errs, tau, beta)
+
+    tile_scores = jax.checkpoint(tile_scores)
+
+    def step(carry, xs):
+        return carry, tile_scores(*xs)
+
+    _, ys = jax.lax.scan(step, None, (rv_tiles, tv_tiles))
+    return ys.reshape(-1)[:H]
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+def _score_select(Rs, ts, coords, pixels, f, c, tau, beta, use_pallas,
+                  chunk, interpret):
+    if use_pallas:
+        best_i, best_s, _ = _select_pallas_raw(
+            Rs, ts, coords, pixels, f, c, tau, beta, interpret
+        )
+        return best_i, best_s
+    return _select_chunked_raw(Rs, ts, coords, pixels, f, c, tau, beta,
+                               chunk)
+
+
+def _select_fwd(Rs, ts, coords, pixels, f, c, tau, beta, use_pallas, chunk,
+                interpret):
+    best_i, best_s = _score_select(Rs, ts, coords, pixels, f, c, tau, beta,
+                                   use_pallas, chunk, interpret)
+    return (best_i, best_s), (Rs, ts, coords, pixels, f, c, best_i)
+
+
+def _select_bwd(tau, beta, use_pallas, chunk, interpret, res, g):
+    """Backward of the fused-select forward: recompute ONLY the winner's
+    score path (one hypothesis x all cells) and differentiate it — the
+    gradient of an argmax-selected score flows through the selected branch
+    alone, so nothing errmap-shaped is ever needed.  The recompute mirrors
+    the engine that ran forward: kernel math (``soft_inlier_scores_fused``)
+    for the Pallas kernel, errmap math for the chunked sibling."""
+    Rs, ts, coords, pixels, f, c, best_i = res
+    _, g_score = g  # best_idx is integer-valued: its cotangent is vacuous
+
+    def winner_score(Rs_, ts_, coords_, pixels_, f_, c_):
+        R, t = Rs_[best_i], ts_[best_i]
+        if use_pallas:
+            return soft_inlier_scores_fused(
+                R[None], t[None], coords_, pixels_, f_, c_, tau, beta
+            )[0]
+        errs = reprojection_errors(R, t, coords_, pixels_, f_, c_)
+        return soft_inlier_score(errs, tau, beta)
+
+    _, vjp = jax.vjp(winner_score, Rs, ts, coords, pixels, f, c)
+    return vjp(g_score)
+
+
+_score_select.defvjp(_select_fwd, _select_bwd)
+
+
+@partial(jax.jit, static_argnames=("tau", "beta", "use_pallas", "chunk",
+                                   "interpret"))
+def soft_inlier_score_select(
+    Rs: jnp.ndarray,
+    ts: jnp.ndarray,
+    coords: jnp.ndarray,
+    pixels: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    tau: float,
+    beta: float,
+    use_pallas: bool = False,
+    chunk: int = 64,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Differentiable fused score+select: (best_idx, best_score) without
+    materializing the errmap OR the (H,) score vector.
+
+    ``use_pallas=True`` runs the VMEM kernel (``_select_pallas_raw``;
+    ``interpret=True`` for off-TPU equivalence tests); ``use_pallas=False``
+    runs the chunked XLA sibling whose winner is bit-identical to
+    ``jnp.argmax`` of the errmap impl's scores, tie inputs included.
+    Gradients recompute only the winner's score path (``_select_bwd``).
+    """
+    return _score_select(Rs, ts, coords, pixels,
+                         jnp.float32(f), jnp.asarray(c, jnp.float32),
+                         tau, beta, use_pallas, chunk, interpret)

@@ -304,24 +304,41 @@ class SceneRegistry:
         self._fns: dict = {}
         self._fns_lock = threading.Lock()
 
-    def _fn_for(self, entry: SceneEntry, route_k: int | None = None):
+    def _fn_for(self, entry: SceneEntry, route_k: int | None = None,
+                n_hyps: int | None = None):
         """The compiled program serving ``entry``: dense when ``route_k``
         is None (and the scene's cfg sets no ``serve_topk``), else the
-        gating-first routed program for top-``route_k`` experts.  Programs
-        are cached per (bucket key, K) — scenes sharing preset+cfg share
-        every routed program too, so hot-swap stays recompile-free at
-        every K."""
+        gating-first routed program for top-``route_k`` experts.
+        ``n_hyps`` overrides the scene config's hypothesis budget for this
+        program — the raise-the-budget knob ISSUE 8 opened: with the
+        streamed score+select path the errmap HBM term no longer scales
+        with n_hyps, so a scene can serve a larger search without a new
+        manifest entry.  Programs are cached per (bucket key, K, n_hyps) —
+        scenes sharing preset+cfg share every program, so hot-swap stays
+        recompile-free at every (K, n_hyps)."""
+        import dataclasses
+
         if route_k is None and entry.ransac.serve_topk > 0:
             route_k = entry.ransac.serve_topk
-        key = (entry.bucket_key(), route_k)
+        if n_hyps is not None and n_hyps < 1:
+            # Fail at the boundary, not with a shape error inside jit.
+            raise ValueError(f"n_hyps override must be >= 1, got {n_hyps}")
+        if n_hyps == entry.ransac.n_hyps:
+            n_hyps = None  # the scene's own budget: same program, one key
+        # NOTE: like route_k, every distinct override is a PERMANENT cached
+        # program (static shapes) — callers own the cardinality.  Pick a
+        # small ladder of budgets (and prewarm it), don't sweep.
+        key = (entry.bucket_key(), route_k, n_hyps)
         with self._fns_lock:
             fn = self._fns.get(key)
             if fn is None:
+                cfg = entry.ransac if n_hyps is None else \
+                    dataclasses.replace(entry.ransac, n_hyps=n_hyps)
                 fn = (
-                    make_scene_bucket_fn(entry.preset, entry.ransac)
+                    make_scene_bucket_fn(entry.preset, cfg)
                     if route_k is None
                     else make_routed_scene_bucket_fn(
-                        entry.preset, entry.ransac, route_k
+                        entry.preset, cfg, route_k
                     )
                 )
                 self._fns[key] = fn
@@ -330,12 +347,14 @@ class SceneRegistry:
     def infer_fn(self):
         """The dispatcher-facing callable: ``fn(batch, scene[, route_k])``
         — ``route_k`` selects the top-K routed program for the dispatch
-        (None = the scene's default: dense, or ``cfg.serve_topk``)."""
+        (None = the scene's default: dense, or ``cfg.serve_topk``);
+        ``n_hyps`` (keyword-only) selects a hypothesis-budget override
+        program (see :meth:`_fn_for`)."""
 
-        def serve(batch, scene, route_k=None):
+        def serve(batch, scene, route_k=None, n_hyps=None):
             entry = self.manifest.resolve(scene)
             params = self.cache.get(entry)
-            return self._fn_for(entry, route_k)(params, batch)
+            return self._fn_for(entry, route_k, n_hyps)(params, batch)
 
         serve._cache_size = self.compile_cache_size
         return serve
@@ -353,23 +372,27 @@ class SceneRegistry:
         self.cache.get(self.manifest.resolve(scene_id))
 
     def prewarm_programs(self, scene_id: str, frame_buckets,
-                         route_ks=(None,)) -> int:
+                         route_ks=(None,), n_hyps_overrides=(None,)) -> int:
         """Compile (and run once, on zero frames) every (K, frame-bucket)
         program a scene's traffic — including an SLO degradation ladder
         (serve.slo.SLOPolicy.degrade_route_k) — can reach, OFF the hot
         path.  Degrading under overload swaps a lane to a cheaper
         already-compiled static program (DESIGN.md §12); prewarming is
         what makes even the *first* degraded dispatch recompile-free.
+        ``n_hyps_overrides`` prewarms hypothesis-budget override programs
+        too (see :meth:`_fn_for`).
         Returns the compiled-program count afterwards (the jit cache-miss
         counter tests pin across degrade events)."""
         import jax
 
         from esac_tpu.serve.batching import MIN_LANES
 
+        import itertools
+
         entry = self.manifest.resolve(scene_id)
         params = self.cache.get(entry)
-        for k in route_ks:
-            fn = self._fn_for(entry, k)
+        for k, nh in itertools.product(route_ks, n_hyps_overrides):
+            fn = self._fn_for(entry, k, nh)
             for bucket in sorted(set(frame_buckets)):
                 B = max(int(bucket), MIN_LANES)
                 batch = {

@@ -511,3 +511,107 @@ def test_loadtest_knee_is_longest_passing_prefix():
     # Non-monotone: 0.8 failed, 1.2 "passed" by luck -> knee stays at 0.4.
     noisy = [pt(0.4, 1.0), pt(0.8, 0.958), pt(1.2, 1.0), pt(2.0, 0.6)]
     assert bench._loadtest_knee(noisy)["offered_x_capacity"] == 0.4
+
+
+# ---------------- scoring driver contract (ISSUE 8) ----------------
+
+def _canned_scoring():
+    """Minimal-but-complete scoring-sweep payload: the schema the driver
+    and the committed .scoring_fused.json artifact rely on."""
+    def point(n_hyps, fs_rate):
+        return {
+            "n_hyps": n_hyps,
+            "total_hyps_per_dispatch": 16 * n_hyps,
+            "errmap_term_mb": round(16 * n_hyps * 4800 * 4 / 1e6, 2),
+            "impls": {
+                impl: {"dispatch_ms": 2.0, "hyps_per_s": rate,
+                       "wall_s_spread": [0.002, 0.002, 0.002]}
+                for impl, rate in (("errmap", 1000.0), ("fused", 1500.0),
+                                   ("fused_select", fs_rate))
+            },
+            "winner_bit_identical": True,
+            "fused_select_speedup_x": round(fs_rate / 1000.0, 3),
+        }
+
+    return {
+        "batch_frames": 16,
+        "n_cells": 4800,
+        "n_hyps_sweep": [64, 1024],
+        "curve": [point(64, 1100.0), point(1024, 2000.0)],
+        "winner_bit_identical_all": True,
+        "note": "canned",
+    }
+
+
+def test_scoring_main_emits_one_json_line_and_artifact(tmp_path, monkeypatch, capsys):
+    """The driver contract: ONE parseable JSON line on stdout, headline
+    from the largest-n_hyps fused_select leg, winner agreement surfaced,
+    and the .scoring_fused.json artifact with platform + recorded_at."""
+    monkeypatch.setattr(bench, "_SCORING_FILE", tmp_path / "scoring.json")
+    monkeypatch.setattr(
+        bench, "measure_on_device",
+        lambda *a, **k: {"scoring": _canned_scoring(), "platform": "tpu",
+                         "device_kind": "fake-tpu"},
+    )
+    bench._scoring_main([], [0.0, 0.0, 0.0])
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1, f"expected ONE JSON line, got {len(lines)}"
+    out = json.loads(lines[0])
+    assert out["metric"] == "scoring_fused_select_hyps_per_s_at_1024"
+    assert out["value"] == 2000.0
+    assert out["unit"] == "hyps/s"
+    assert "vs_baseline" in out
+    assert out["fused_select_speedup_x_at_max"] == 2.0
+    assert out["winner_bit_identical_all"] is True
+    assert out["device_kind"] == "fake-tpu"
+    assert "contention" in out
+    artifact = json.loads((tmp_path / "scoring.json").read_text())
+    assert artifact["platform"] == "tpu"
+    assert "recorded_at" in artifact
+    assert len(artifact["scoring"]["curve"]) == 2
+
+
+def test_scoring_cpu_fallback_carries_provenance(tmp_path, monkeypatch, capsys):
+    """Relay wedged -> the sweep measures on CPU and SAYS so: note field
+    on the JSON line, platform "cpu" in the artifact."""
+    monkeypatch.setattr(bench, "_SCORING_FILE", tmp_path / "scoring.json")
+    monkeypatch.setattr(bench, "measure_on_device", lambda *a, **k: None)
+    monkeypatch.setattr(bench, "_measure_scoring",
+                        lambda *a, **k: _canned_scoring())
+    bench._scoring_main([], [0.0, 0.0, 0.0])
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1
+    out = json.loads(lines[0])
+    assert "CPU" in out["note"] or "cpu" in out["note"]
+    artifact = json.loads((tmp_path / "scoring.json").read_text())
+    assert artifact["platform"] == "cpu"
+    assert artifact["note"] == out["note"]
+
+
+def test_scoring_artifact_schema_committed():
+    """The committed .scoring_fused.json satisfies the schema the driver
+    consumes: a full impl matrix per point, recorded winner agreement, and
+    (on a CPU record) the bit-identity acceptance actually holding."""
+    import pathlib
+
+    path = pathlib.Path(bench.__file__).parent / ".scoring_fused.json"
+    if not path.exists():
+        import pytest
+
+        pytest.skip("no committed scoring artifact yet")
+    artifact = json.loads(path.read_text())
+    for key in ("metric", "value", "unit", "platform", "recorded_at",
+                "scoring"):
+        assert key in artifact, key
+    sc = artifact["scoring"]
+    assert sc["n_hyps_sweep"] == [p["n_hyps"] for p in sc["curve"]]
+    for p in sc["curve"]:
+        assert set(p["impls"]) == {"errmap", "fused", "fused_select"}
+        for leg in p["impls"].values():
+            assert leg["hyps_per_s"] > 0
+        assert isinstance(p["winner_bit_identical"], bool)
+        assert p["errmap_term_mb"] > 0
+    if artifact["platform"] == "cpu":
+        # On CPU fused_select runs the chunked errmap-math sibling: the
+        # winner must be bit-identical at EVERY sweep point.
+        assert sc["winner_bit_identical_all"] is True

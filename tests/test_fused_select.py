@@ -1,0 +1,393 @@
+"""Fused score+select (ISSUE 8): stream hypotheses through selection.
+
+The load-bearing claims:
+
+- **winner bit-parity**: under ``scoring_impl="fused_select"`` every
+  inference entry point's winner (pose, best index / expert id,
+  inlier_frac) is bit-identical to the errmap argmax — on CPU the select
+  runs the chunked XLA sibling, whose per-hypothesis scores ARE the errmap
+  formulation's and whose tie-break matches ``jnp.argmax`` exactly;
+- **tie-breaking**: duplicated hypotheses (exact score ties) resolve to
+  the FIRST index, across chunk and VMEM-block boundaries, in both the
+  chunked sibling and the Pallas kernel (interpret mode);
+- **zero-pad leak**: hypothesis padding (to the chunk / HYP_BLOCK
+  multiple) and cell padding can never win or perturb scores;
+- **winner-only backward**: the custom_vjp of the fused-select forward
+  differentiates exactly the winner's score path;
+- **the training path** under fused_select keeps all scores (chunked,
+  remat) with gradients matching errmap;
+- **serve pins survive**: K=M routed == dense bitwise and routed
+  bucket-invariance hold with the new impl, and the registry's n_hyps
+  override plumbing compiles per-override programs that scenes share.
+
+Everything runs tiny (120x160 frames -> 300 cells, <= 40 hypotheses).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esac_tpu.data import CAMERA_F, make_correspondence_frame
+from esac_tpu.geometry.rotations import rodrigues
+from esac_tpu.ransac import RansacConfig
+from esac_tpu.ransac.kernel import generate_hypotheses
+from esac_tpu.ransac.pallas_scoring import (
+    _select_pallas_raw,
+    soft_inlier_score_select,
+    soft_inlier_scores_chunked,
+    soft_inlier_scores_pallas,
+)
+from esac_tpu.ransac.scoring import reprojection_error_map, soft_inlier_score
+
+F = jnp.float32(CAMERA_F / 4.0)
+C = jnp.array([80.0, 60.0])
+FRAME_KW = dict(height=120, width=160, f=CAMERA_F / 4.0, c=(80.0, 60.0))
+
+
+def _fixture(seed=0, n_hyps=40):
+    frame = make_correspondence_frame(
+        jax.random.key(seed), noise=0.02, outlier_frac=0.3, **FRAME_KW
+    )
+    cfg = RansacConfig(n_hyps=n_hyps)
+    rvecs, tvecs = generate_hypotheses(
+        jax.random.key(seed + 1), frame["coords"], frame["pixels"], F, C, cfg
+    )
+    return frame, rvecs, tvecs
+
+
+def _errmap_scores(rvecs, tvecs, coords, pixels):
+    return soft_inlier_score(
+        reprojection_error_map(rvecs, tvecs, coords, pixels, F, C), 10.0, 0.5
+    )
+
+
+# ---------------------------------------------------------------- kernel layer
+
+
+def test_chunked_select_bit_matches_errmap_argmax():
+    """The chunked XLA sibling's winner == jnp.argmax of the errmap scores,
+    index AND score bit-for-bit (40 hyps, chunk 16: pad leg included)."""
+    frame, rvecs, tvecs = _fixture()
+    ref = _errmap_scores(rvecs, tvecs, frame["coords"], frame["pixels"])
+    best_i, best_s = soft_inlier_score_select(
+        jax.vmap(rodrigues)(rvecs), tvecs, frame["coords"], frame["pixels"],
+        F, C, 10.0, 0.5, use_pallas=False, chunk=16,
+    )
+    assert int(best_i) == int(jnp.argmax(ref))
+    assert float(best_s) == float(ref[jnp.argmax(ref)])
+
+
+def test_chunked_scores_match_materialized():
+    """soft_inlier_scores_chunked == the materializing formulation per
+    hypothesis (fusion-level f32 jitter only) with the same argmax."""
+    frame, rvecs, tvecs = _fixture(seed=2)
+    ref = _errmap_scores(rvecs, tvecs, frame["coords"], frame["pixels"])
+    for chunk in (7, 16, 40, 64):  # non-divisor, divisor, exact, clamped
+        got = soft_inlier_scores_chunked(
+            rvecs, tvecs, frame["coords"], frame["pixels"], F, C, 10.0, 0.5,
+            impl="errmap", chunk=chunk,
+        )
+        assert got.shape == ref.shape
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-3
+        )
+        assert int(jnp.argmax(got)) == int(jnp.argmax(ref)), chunk
+
+
+def test_pallas_select_kernel_matches_kernel_scores():
+    """The VMEM select kernel (interpret) == jnp.argmax over the scoring
+    kernel's own output: index, score and the winner pose row all
+    bit-identical (same math, selection fused in)."""
+    frame, rvecs, tvecs = _fixture(seed=4)
+    Rs = jax.vmap(rodrigues)(rvecs)
+    kscores = soft_inlier_scores_pallas(
+        Rs, tvecs, frame["coords"], frame["pixels"], F, C, 10.0, 0.5,
+        interpret=True,
+    )
+    bi, bs, bpose = _select_pallas_raw(
+        Rs, tvecs, frame["coords"], frame["pixels"], F, C, 10.0, 0.5,
+        interpret=True,
+    )
+    want = int(jnp.argmax(kscores))
+    assert int(bi) == want
+    assert float(bs) == float(kscores[want])
+    np.testing.assert_array_equal(
+        np.asarray(bpose[:9]), np.asarray(Rs[want].reshape(9)))
+    np.testing.assert_array_equal(np.asarray(bpose[9:]), np.asarray(tvecs[want]))
+
+
+def test_select_tie_break_first_max_wins():
+    """Crafted exact ties: the winning hypothesis duplicated at a later
+    index — across a chunk boundary for the XLA sibling and across a
+    HYP_BLOCK (8) boundary for the kernel — must NEVER displace the first
+    occurrence, matching jnp.argmax."""
+    frame, rvecs, tvecs = _fixture(seed=6, n_hyps=24)
+    ref = _errmap_scores(rvecs, tvecs, frame["coords"], frame["pixels"])
+    w = int(jnp.argmax(ref))
+    # Duplicate the winner into later slots: same block, next chunk/block,
+    # and the final (padded) tile.
+    for dup in (w + 1, 15, 23):
+        if dup == w:
+            continue
+        rv = rvecs.at[dup].set(rvecs[w])
+        tv = tvecs.at[dup].set(tvecs[w])
+        scores = _errmap_scores(rv, tv, frame["coords"], frame["pixels"])
+        want = int(jnp.argmax(scores))  # first max wins by contract
+        assert want == min(w, dup)
+        bi, _ = soft_inlier_score_select(
+            jax.vmap(rodrigues)(rv), tv, frame["coords"], frame["pixels"],
+            F, C, 10.0, 0.5, use_pallas=False, chunk=7,
+        )
+        assert int(bi) == want, ("chunked", dup)
+        ki, _, _ = _select_pallas_raw(
+            jax.vmap(rodrigues)(rv), tv, frame["coords"], frame["pixels"],
+            F, C, 10.0, 0.5, interpret=True,
+        )
+        # The kernel ties against ITS OWN scores (kernel math): duplicates
+        # are exact ties there too, so first-wins is the same check.
+        assert int(ki) == want, ("pallas", dup)
+
+
+def test_select_zero_pad_never_wins():
+    """VMEM-tile zero-pad leak: every REAL score ~0 (all cells behind the
+    camera) while padded rows also score exactly 0 — the winner must be a
+    real index (0, the first tie), never a padding row, in both engines;
+    H=5 exercises in-block hypothesis padding AND a padded chunk tail."""
+    coords = jnp.tile(jnp.array([[0.0, 0.0, -5.0]]), (64, 1))
+    pixels = jnp.tile(C[None], (64, 1))
+    Rs = jnp.tile(jnp.eye(3)[None], (5, 1, 1))
+    ts = jnp.zeros((5, 3))
+    bi, bs = soft_inlier_score_select(
+        Rs, ts, coords, pixels, F, C, 10.0, 0.5, use_pallas=False, chunk=4,
+    )
+    assert int(bi) == 0 and float(bs) == 0.0
+    ki, ks, _ = _select_pallas_raw(
+        Rs, ts, coords, pixels, F, C, 10.0, 0.5, interpret=True,
+    )
+    assert int(ki) == 0 and float(ks) == 0.0
+
+
+def test_select_backward_is_winner_only():
+    """custom_vjp backward == jax.grad of the winner's (fixed-index) score
+    through the errmap math; non-winner pose rows get exactly zero grad."""
+    frame, rvecs, tvecs = _fixture(seed=8, n_hyps=16)
+    Rs = jax.vmap(rodrigues)(rvecs)
+    ref = _errmap_scores(rvecs, tvecs, frame["coords"], frame["pixels"])
+    w = int(jnp.argmax(ref))
+
+    def loss_select(Rs_, ts_, coords_):
+        _, s = soft_inlier_score_select(
+            Rs_, ts_, coords_, frame["pixels"], F, C, 10.0, 0.5,
+            use_pallas=False, chunk=5,
+        )
+        return s
+
+    from esac_tpu.geometry.camera import reprojection_errors
+
+    def loss_winner(Rs_, ts_, coords_):
+        errs = reprojection_errors(
+            Rs_[w], ts_[w], coords_, frame["pixels"], F, C
+        )
+        return soft_inlier_score(errs, 10.0, 0.5)
+
+    gs = jax.grad(loss_select, argnums=(0, 1, 2))(Rs, tvecs, frame["coords"])
+    gw = jax.grad(loss_winner, argnums=(0, 1, 2))(Rs, tvecs, frame["coords"])
+    for a, b in zip(gs, gw):
+        # Same math, differently compiled f32 programs (the custom_vjp
+        # recompute vs the reference grad): tolerance is the f32 fusion
+        # jitter envelope, not a backward-math gap.
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=1e-3)
+    mask = np.ones(16, bool)
+    mask[w] = False
+    assert np.all(np.asarray(gs[0])[mask] == 0.0)
+    assert np.all(np.asarray(gs[1])[mask] == 0.0)
+
+
+# ------------------------------------------------------------- entry points
+
+
+FS = dict(scoring_impl="fused_select")
+
+
+def _frames_inputs(B=3, M=3, seed=20):
+    frames = [
+        make_correspondence_frame(
+            jax.random.key(seed + i), noise=0.01, outlier_frac=0.3, **FRAME_KW
+        )
+        for i in range(B)
+    ]
+    pixels_B = jnp.stack([f["pixels"] for f in frames])
+    keys = jax.random.split(jax.random.key(seed + 50), B)
+    f_B = jnp.full((B,), float(F), jnp.float32)
+    coords_BM = jnp.stack([
+        jnp.stack([
+            frames[b]["coords"] + 0.3 * m for m in range(M)
+        ]) for b in range(B)
+    ])  # (B, M, N, 3): expert 0 is the informative one
+    logits_B = jnp.tile(jnp.linspace(1.0, 0.0, M)[None], (B, 1))
+    return frames, keys, coords_BM, logits_B, pixels_B, f_B
+
+
+def _assert_winner_bitwise(a, b, keys):
+    for k in keys:
+        np.testing.assert_array_equal(
+            np.asarray(a[k]), np.asarray(b[k]), err_msg=k
+        )
+
+
+def test_dsac_infer_frames_winner_bit_parity():
+    from esac_tpu.ransac import dsac_infer_frames
+
+    frames, keys, coords_BM, _, pixels_B, f_B = _frames_inputs()
+    coords_B = coords_BM[:, 0]
+    outs = {}
+    for extra in ({}, FS):
+        cfg = RansacConfig(n_hyps=24, refine_iters=2, score_chunk=16, **extra)
+        outs[bool(extra)] = dsac_infer_frames(
+            keys, coords_B, pixels_B, f_B, C, cfg
+        )
+    _assert_winner_bitwise(outs[False], outs[True],
+                           ("rvec", "tvec", "best", "inlier_frac"))
+    assert "scores" not in outs[True] and "score" in outs[True]
+    # The streamed winner score == the errmap path's scores[best].
+    picked = np.take_along_axis(
+        np.asarray(outs[False]["scores"]),
+        np.asarray(outs[False]["best"])[:, None], 1,
+    )[:, 0]
+    np.testing.assert_array_equal(picked, np.asarray(outs[True]["score"]))
+
+
+def test_esac_infer_frames_winner_bit_parity():
+    from esac_tpu.ransac import esac_infer_frames
+
+    _, keys, coords_BM, logits_B, pixels_B, f_B = _frames_inputs()
+    outs = {}
+    for extra in ({}, FS):
+        cfg = RansacConfig(n_hyps=16, refine_iters=2, score_chunk=16, **extra)
+        outs[bool(extra)] = esac_infer_frames(
+            keys, logits_B, coords_BM, pixels_B, f_B, C, cfg
+        )
+    _assert_winner_bitwise(
+        outs[False], outs[True],
+        ("rvec", "tvec", "expert", "inlier_frac", "gating_probs"),
+    )
+    assert "scores" not in outs[True] and "score" in outs[True]
+
+
+def test_esac_infer_topk_frames_winner_bit_parity():
+    from esac_tpu.ransac import esac_infer_topk_frames
+
+    _, keys, coords_BM, logits_B, pixels_B, f_B = _frames_inputs()
+    outs = {}
+    for extra in ({}, FS):
+        cfg = RansacConfig(n_hyps=16, refine_iters=2, score_chunk=16, **extra)
+        outs[bool(extra)] = esac_infer_topk_frames(
+            keys, logits_B, coords_BM, pixels_B, f_B, C, cfg, k=2
+        )
+    _assert_winner_bitwise(
+        outs[False], outs[True],
+        ("rvec", "tvec", "expert", "inlier_frac", "experts_evaluated"),
+    )
+
+
+def test_esac_infer_routed_frames_winner_bit_parity_with_drops():
+    """Routed entry under fused_select vs errmap, including a capacity-
+    dropped slot and one fully-dropped frame (all slots dead -> finite
+    garbage, same bits both ways)."""
+    from esac_tpu.ransac import esac_infer_routed_frames
+
+    _, keys, coords_BM, logits_B, pixels_B, f_B = _frames_inputs()
+    B, M = coords_BM.shape[:2]
+    K = 2
+    selected = jnp.tile(jnp.asarray([0, 2], jnp.int32)[None], (B, 1))
+    kept = jnp.asarray([[True, True], [True, False], [False, False]])
+    coords_sel = coords_BM[jnp.arange(B)[:, None], selected]
+    outs = {}
+    for extra in ({}, FS):
+        cfg = RansacConfig(n_hyps=16, refine_iters=2, score_chunk=16, **extra)
+        outs[bool(extra)] = esac_infer_routed_frames(
+            keys, logits_B, coords_sel, selected, kept, pixels_B, f_B, C, cfg
+        )
+    _assert_winner_bitwise(
+        outs[False], outs[True],
+        ("rvec", "tvec", "expert", "inlier_frac", "experts_evaluated"),
+    )
+    assert "scores" not in outs[True] and "score" in outs[True]
+    # The fully-dropped frame fails identically: winner score -inf.
+    assert np.isneginf(np.asarray(outs[True]["score"])[2])
+
+
+def test_sharded_frames_dynamic_winner_bit_parity():
+    """The expert-sharded frames sibling consumes the streamed winner:
+    fused_select == errmap bitwise on the 8-virtual-device mesh."""
+    from esac_tpu.parallel import make_mesh
+    from esac_tpu.parallel.esac_sharded import (
+        make_esac_infer_sharded_frames_dynamic,
+    )
+
+    mesh = make_mesh(n_data=1, n_expert=8)
+    _, keys, coords_BM, _, pixels_B, f_B = _frames_inputs(B=2, M=8)
+    batch = {
+        "key": keys, "coords_all": coords_BM, "pixels": pixels_B, "f": f_B,
+    }
+    outs = {}
+    for extra in ({}, FS):
+        cfg = RansacConfig(n_hyps=8, refine_iters=2, score_chunk=4, **extra)
+        with mesh:
+            outs[bool(extra)] = make_esac_infer_sharded_frames_dynamic(
+                mesh, cfg
+            )(batch, C)
+    _assert_winner_bitwise(outs[False], outs[True],
+                           ("rvec", "tvec", "expert", "score"))
+
+
+def test_fused_select_training_grad_matches_errmap():
+    """Training under fused_select (chunked+remat scoring, ALL scores kept
+    for the softmax expectation) trains with gradients equal to errmap."""
+    from esac_tpu.ransac import dsac_train_loss
+
+    frame = make_correspondence_frame(jax.random.key(30), noise=0.02,
+                                      **FRAME_KW)
+
+    def grad_for(extra):
+        cfg = RansacConfig(n_hyps=16, train_refine_iters=1, score_chunk=4,
+                           **extra)
+        return jax.grad(
+            lambda c_: dsac_train_loss(
+                jax.random.key(31), c_, frame["pixels"], F, C,
+                rodrigues(frame["rvec"]), frame["tvec"], cfg,
+            )[0]
+        )(frame["coords"])
+
+    ge = grad_for({})
+    gf = grad_for(FS)
+    assert jnp.all(jnp.isfinite(gf))
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(ge),
+                               rtol=5e-3, atol=1e-5)
+
+
+def test_use_pallas_scoring_normalized_once():
+    """Satellite: the deprecated flag resolves into scoring_impl in ONE
+    place (__post_init__) — the two spellings are the same static config,
+    and dataclasses.replace keeps the resolution stable."""
+    a = RansacConfig(use_pallas_scoring=True)
+    b = RansacConfig(scoring_impl="pallas")
+    assert a.scoring_impl == "pallas" and a.use_pallas_scoring is False
+    assert a == b and hash(a) == hash(b)
+    c = dataclasses.replace(a, n_hyps=32)
+    assert c.scoring_impl == "pallas" and c.use_pallas_scoring is False
+
+
+def test_unknown_scoring_impl_fails_loudly_on_inference():
+    from esac_tpu.ransac import dsac_infer
+
+    frame = make_correspondence_frame(jax.random.key(32), **FRAME_KW)
+    with pytest.raises(ValueError, match="scoring_impl"):
+        dsac_infer(
+            jax.random.key(33), frame["coords"], frame["pixels"], F, C,
+            RansacConfig(n_hyps=8, scoring_impl="bogus"),
+        )

@@ -1236,21 +1236,32 @@ def test_committed_ledger_matches_tree_exactly(traced_registry):
 
 
 def test_committed_ledger_quantifies_the_scoring_errmap():
-    """DESIGN.md §9's "scoring materializes per-hypothesis errmaps" claim
-    as a committed number: the esac_infer_frames entry records the errmap
-    footprint and that a tensor of exactly that size rides the trace."""
-    from esac_tpu.lint.ledger import LEDGER_NAME, load_ledger
+    """DESIGN.md §9's errmap claim as a committed number — ISSUE 8 flipped
+    its sign on the inference side: every INFERENCE entry records the
+    would-be errmap footprint with ``present_in_trace`` FALSE (scoring +
+    selection stream through score_chunk tiles; the fusion evidence), and
+    only the materializing TRAINING record (scoring_errmap_grad) keeps a
+    true presence bit."""
+    from esac_tpu.lint.ledger import _ERRMAP_DIMS, LEDGER_NAME, load_ledger
 
     committed = load_ledger(REPO / LEDGER_NAME)
-    e = committed["esac_infer_frames"]["errmap"]
-    dims = e["trace_dims"]
-    assert e["bytes_at_trace_shapes"] == (
-        dims["B"] * dims["M"] * dims["n_hyps"] * dims["n_cells"] * 4
-    )
-    assert e["present_in_trace"] is True
-    assert committed["scoring_errmap_grad"]["errmap"]["present_in_trace"]
+    for name, dims in _ERRMAP_DIMS.items():
+        e = committed[name]["errmap"]
+        assert e["trace_dims"] == dims
+        want = 4
+        for d in dims.values():
+            want *= d
+        assert e["bytes_at_trace_shapes"] == want, name
+        if name == "scoring_errmap_grad":
+            assert e["present_in_trace"] is True, name
+        else:
+            assert e["present_in_trace"] is False, (
+                f"{name}: the errmap rematerialized on an inference entry "
+                "(the ISSUE 8 fusion regressed)"
+            )
     # And the entry-level peaks the fusion argument needs are committed.
-    for name in ("esac_infer_frames", "scoring_errmap_grad"):
+    for name in ("esac_infer_frames", "dsac_infer_fused_select",
+                 "scoring_errmap_grad"):
         entry = committed[name]
         assert entry["peak_intermediate_bytes"] > 0
         assert entry["flops"] > 0

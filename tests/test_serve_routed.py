@@ -457,3 +457,89 @@ def test_heavy_sharded_routed_serve_agrees_with_single_chip():
     assert int(out_drop["expert"][2]) == int(selected[2, 0])  # in range
     assert np.isfinite(np.asarray(out_drop["rvec"])).all()
     assert np.isfinite(np.asarray(out_drop["tvec"])).all()
+
+
+# ---------------- fused score+select (ISSUE 8) serve pins ----------------
+
+FS_CFG = dataclasses.replace(CFG, scoring_impl="fused_select", score_chunk=4)
+# fused_select fuses the score vector away: 'score' replaces 'scores'.
+FS_POSE_KEYS = ("rvec", "tvec", "score", "expert", "gating_probs",
+                "inlier_frac")
+
+
+def test_k_eq_m_bit_identical_to_dense_fused_select(params):
+    """The K=M≡dense pin survives the new impl: the routed program under
+    scoring_impl="fused_select" reproduces the fused_select dense bucket
+    program bit-for-bit — and its winner fields match the ERRMAP dense
+    program bit-for-bit too (the acceptance contract: fused-select winner
+    == errmap argmax on the serve path)."""
+    dense_fs = make_scene_bucket_fn(PRESET, FS_CFG)
+    routed_fs = make_routed_scene_bucket_fn(PRESET, FS_CFG, M)
+    dense_errmap = make_scene_bucket_fn(PRESET, CFG)
+    batch = {
+        "key": jax.random.split(jax.random.key(2), 4),
+        "image": jnp.stack([jnp.asarray(_frame(i)["image"])
+                            for i in range(4)]),
+    }
+    out_d = jax.block_until_ready(dense_fs(params["a"], batch))
+    batch = {
+        "key": jax.random.split(jax.random.key(2), 4),
+        "image": jnp.stack([jnp.asarray(_frame(i)["image"])
+                            for i in range(4)]),
+    }
+    out_r = jax.block_until_ready(routed_fs(params["a"], batch))
+    assert "scores" not in out_d and "scores" not in out_r
+    assert _bitwise_equal(out_d, out_r, keys=FS_POSE_KEYS)
+    batch = {
+        "key": jax.random.split(jax.random.key(2), 4),
+        "image": jnp.stack([jnp.asarray(_frame(i)["image"])
+                            for i in range(4)]),
+    }
+    out_e = jax.block_until_ready(dense_errmap(params["a"], batch))
+    assert _bitwise_equal(out_e, out_d,
+                          keys=("rvec", "tvec", "expert", "inlier_frac"))
+
+
+def test_routed_bit_identical_across_frame_buckets_fused_select(params):
+    """The cross-bucket bit-identity pin survives the new impl: a routed
+    fused_select request's result does not depend on its frame bucket."""
+    m = SceneManifest()
+    m.add(SceneEntry(
+        scene_id="a", version=1, expert_ckpt="unused",
+        gating_ckpt="unused", preset=PRESET, ransac=FS_CFG,
+    ))
+    reg = SceneRegistry(m, loader=lambda e: params[e.scene_id])
+    disp = reg.dispatcher(FS_CFG, start_worker=False)
+    frames = [_frame(i) for i in range(3)]
+    bulk = disp.infer_many(frames, scene="a", route_k=2)     # 4-bucket
+    singles = [disp.infer_one(f, scene="a", route_k=2) for f in frames]
+    for got, want in zip(bulk, singles):
+        assert _bitwise_equal(got, want, keys=FS_POSE_KEYS)
+        assert np.array_equal(got["experts_evaluated"],
+                              want["experts_evaluated"])
+
+
+def test_registry_n_hyps_override_plumbing(params):
+    """ISSUE 8 config plumbing: the registry serves a per-dispatch
+    hypothesis-budget override (the knob the streamed path makes cheap to
+    raise) as its own cached program — scenes sharing the bucket share it,
+    and repeat dispatches never recompile."""
+    reg = _registry(params, scene_ids=("a", "b"))
+    serve = reg.infer_fn()
+
+    def batch(n):
+        return {
+            "key": jax.random.split(jax.random.key(7), n),
+            "image": jnp.stack([jnp.asarray(_frame(i)["image"])
+                                for i in range(n)]),
+        }
+
+    base = jax.block_until_ready(serve(batch(2), "a"))
+    big = jax.block_until_ready(serve(batch(2), "a", n_hyps=16))
+    assert base["scores"].shape[-1] == CFG.n_hyps
+    assert big["scores"].shape[-1] == 16
+    compiles = reg.compile_cache_size()
+    # Same override on another scene in the bucket: argument change only.
+    jax.block_until_ready(serve(batch(2), "b", n_hyps=16))
+    jax.block_until_ready(serve(batch(2), "a", n_hyps=16))
+    assert reg.compile_cache_size() == compiles

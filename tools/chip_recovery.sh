@@ -7,7 +7,11 @@
 #
 # Jobs, in order:
 #   1. tools/tpu_probe.py until phase=ok
-#   2. tools/pallas_ab.py          -> .pallas_ab.json (VERDICT #5 hardware A/B)
+#   2. tools/pallas_ab.py          -> .pallas_ab.json (VERDICT #5 hardware
+#      A/B, now incl. ISSUE 8's fused score+select kernel: errmap vs fused
+#      vs pallas vs fused_select full-pipeline + scoring-only + the select
+#      winner-agreement record — the default-deciding evidence for
+#      RansacConfig.scoring_impl)
 #   3. experiments/ref_scale_pipeline.sh (config-#2 accuracy; resumes itself)
 #
 # Probe policy: watch one probe at a time.  A probe that ERRORS out (fast

@@ -1,21 +1,26 @@
 """A/B the fused Pallas scoring kernel vs the XLA path on the real chip.
 
-VERDICT round-1 item #5, extended to every RansacConfig.scoring_impl:
-measure "errmap" / "fused" / "pallas" on hardware and record the result;
-the default flips only on a measured win.  Writes ONE JSON line to stdout
-and to .pallas_ab.json:
+VERDICT round-1 item #5, extended to every RansacConfig.scoring_impl —
+including ISSUE 8's "fused_select" (the fused score+SELECT kernel): measure
+"errmap" / "fused" / "pallas" / "fused_select" on hardware and record the
+result; the default flips only on a measured win.  Writes ONE JSON line to
+stdout and to .pallas_ab.json:
 
   {"<impl>_hyps_per_sec": ...,            # full dsac_infer pipeline, per impl
    "scoring_only_<impl>": ...,            # scoring-stage microbench, per impl
    "max_abs_score_diff_<impl>": ...,      # vs errmap, for impl != errmap
+   "select_winner_agree": ...,            # fused-select idx == errmap argmax
+   "select_winner_score_diff": ...,       # |fused-select score - errmap max|
    "default_candidate": "<impl>",         # fastest impl with score agreement
    "device_kind": ..., "platform": ...,
    # back-compat keys: xla_hyps_per_sec (== errmap), speedup
    # (pallas/errmap), max_abs_score_diff (pallas), scoring_only_xla}
 
-Runs the full dsac_infer pipeline both ways (the kernel sits in the scoring
-slot) plus a scoring-only microbench, at BASELINE.md config #1 shapes.
-Launch detached (wedge safety, CLAUDE.md): never kill this process.
+Runs the full dsac_infer pipeline every way (the kernel sits in the
+scoring slot; fused_select additionally fuses the selection argmax into
+the stream) plus a scoring-only microbench, at BASELINE.md config #1
+shapes.  Launch detached (wedge safety, CLAUDE.md): never kill this
+process.
 """
 
 from __future__ import annotations
@@ -70,7 +75,7 @@ def main() -> None:
            "platform": jax.devices()[0].platform}
 
     # Full-pipeline A/B over every scoring implementation.
-    IMPLS = ("errmap", "fused", "pallas")
+    IMPLS = ("errmap", "fused", "pallas", "fused_select")
     for impl in IMPLS:
         cfg = RansacConfig(n_hyps=N_HYPS, scoring_impl=impl)
         fn = jax.jit(jax.vmap(
@@ -112,12 +117,36 @@ def main() -> None:
         res[f"scoring_only_{impl}"] = round(_rate(fn, xa, N_HYPS), 1)
     res["max_abs_score_diff"] = res["max_abs_score_diff_pallas"]
     res["scoring_only_xla"] = res["scoring_only_errmap"]
+
+    # Fused score+SELECT microbench (ISSUE 8): winner only, no score
+    # vector.  On TPU this runs the VMEM select kernel — the
+    # default-deciding evidence is (a) rate, (b) the winner agreeing with
+    # the errmap argmax (tie-break contract).
+    from esac_tpu.ransac.pallas_scoring import soft_inlier_score_select
+
+    select_fn = jax.jit(lambda rv_, tv_, co_, px_: soft_inlier_score_select(
+        jax.vmap(rodrigues)(rv_), tv_, co_, px_, f32, c, 10.0, 0.5,
+        use_pallas=not interp, interpret=interp))
+    best_i, best_s = select_fn(*xa)
+    res["select_winner_agree"] = bool(
+        int(best_i) == int(jnp.argmax(ref_scores)))
+    res["select_winner_score_diff"] = float(
+        jnp.abs(best_s - jnp.max(ref_scores)))
+    res["scoring_only_fused_select"] = round(_rate(select_fn, xa, N_HYPS), 1)
+
     # The fastest full-pipeline impl with per-hypothesis score agreement
-    # within 1% of a typical score magnitude is the default candidate.
+    # within 1% of a typical score magnitude is the default candidate;
+    # fused_select has no score vector, so its agreement criterion is the
+    # winner itself (index agreement + winner-score within the same tol).
     tol = 0.01 * float(jnp.mean(jnp.abs(ref_scores)) + 1e-9)
-    ok_impls = [i for i in IMPLS
-                if i == "errmap"
-                or res[f"max_abs_score_diff_{i}"] <= max(tol, 0.5)]
+    def _agrees(i):
+        if i == "errmap":
+            return True
+        if i == "fused_select":
+            return (res["select_winner_agree"]
+                    and res["select_winner_score_diff"] <= max(tol, 0.5))
+        return res[f"max_abs_score_diff_{i}"] <= max(tol, 0.5)
+    ok_impls = [i for i in IMPLS if _agrees(i)]
     res["default_candidate"] = max(
         ok_impls, key=lambda i: res[f"{i}_hyps_per_sec"])
 
