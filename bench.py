@@ -86,6 +86,16 @@ ROUTED_HW = 96           # image size: the expert CNNs must dominate for the
                          # (routing buys CNN sparsity, not hypothesis work)
 ROUTED_REPEATS = 5       # median-of-5 per leg (CPU jitter, cf. serve bench)
 
+CHAOS_M = 2              # experts in the chaos drill's synthetic scenes
+CHAOS_HW = 24            # tiny frames: the drill measures FAULT routing
+                         # and recovery, not throughput (cf. loadtest)
+CHAOS_HYPS = 4           # per-expert hypotheses per request
+CHAOS_BUCKET = 2         # one frame bucket: fault accounting, not sweep
+CHAOS_RATE_X = 0.5       # offered load vs closed-loop capacity — below
+                         # the measured 0.8x knee, so every non-fault
+                         # outcome is the fault's signature, not overload
+CHAOS_SECONDS = 2.0      # open-loop window per phase
+
 _REPO = pathlib.Path(__file__).resolve().parent
 _PROBE_FILE = _REPO / ".tpu_probe.json"
 _RESULT_FILE = _REPO / ".bench_device.json"
@@ -94,6 +104,7 @@ _REGISTRY_FILE = _REPO / ".registry_swap.json"
 _ROUTED_FILE = _REPO / ".routed_serve.json"
 _LOADTEST_FILE = _REPO / ".serve_loadtest.json"
 _SCORING_FILE = _REPO / ".scoring_fused.json"
+_CHAOS_FILE = _REPO / ".chaos_drill.json"
 
 
 def _measure_jax(
@@ -890,6 +901,7 @@ def _measure_loadtest(
                 )
                 disp.close()
                 res.pop("per_request_outcomes")
+                res.pop("per_request_error_types", None)
                 points.append({
                     "offered_x_capacity": mult,
                     "offered_rps": round(rate, 2),
@@ -923,6 +935,357 @@ def _measure_loadtest(
             "accounting per point sums to offered (tests pin the "
             "invariant); tiny scenes — queueing behavior, not absolute "
             "throughput, is the measurement"
+        ),
+    }
+
+
+def _measure_chaos(seconds: float = CHAOS_SECONDS) -> dict:
+    """Fleet fault-tolerance chaos drill (ISSUE 9, DESIGN.md §13): an
+    open-loop mixed-scene load over a 4-scene registry while three fault
+    classes are injected — a CORRUPT checkpoint read (manifest content
+    checksums must convert it into typed ChecksumMismatchError failures
+    + lane quarantine, never served garbage), a TRANSIENT IO fault (the
+    loader's capped retry/backoff must absorb it invisibly), and a
+    NaN-WEIGHT version promotion (the scene health breaker must trip and
+    auto-roll back to the last-known-good version).  Reported per fault:
+    outcome accounting that sums exactly to offered, typed-error
+    classes, recovery latency, healthy-scene goodput retention, the
+    post-rollback bit-identity check, the canary-promotion verdict, and
+    the jit cache-miss counter across the whole drill (a rollback is a
+    pointer swap: zero hot-path recompiles).
+
+    Tiny scenes on purpose (cf. the loadtest): the drill measures fault
+    ROUTING — which typed outcome, how fast the recovery — not
+    throughput.
+    """
+    import shutil
+    import tempfile
+
+    root = pathlib.Path(tempfile.mkdtemp(prefix="esac_chaos_"))
+    try:
+        return _measure_chaos_at(root, seconds)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _measure_chaos_at(root: pathlib.Path, seconds: float) -> dict:
+    import collections
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from esac_tpu.models import ExpertNet, GatingNet
+    from esac_tpu.ransac import RansacConfig
+    from esac_tpu.registry import (
+        HealthPolicy, SceneEntry, SceneManifest, ScenePreset, SceneRegistry,
+        compute_entry_checksums, load_scene_params,
+    )
+    from esac_tpu.serve import (
+        FaultInjector, SLOPolicy, poisson_arrivals, run_open_loop,
+    )
+    from esac_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+    H = W = CHAOS_HW
+    M = CHAOS_M
+    preset = ScenePreset(
+        height=H, width=W, num_experts=M,
+        stem_channels=(2, 4, 8), head_channels=8, head_depth=1,
+        gating_channels=(4,), compute_dtype="float32", gated=True,
+    )
+    # Queue depth + deadline sized so the TRANSIENT backlog behind a
+    # faulting scene's slow failing loads (a few tens of ms each, until
+    # quarantine at the 2nd failure) is absorbed rather than shed: the
+    # drill measures fault ROUTING on healthy-lane traffic, so overload
+    # shedding must not alias into the fault signature (the loadtest
+    # owns the overload story).
+    cfg = RansacConfig(n_hyps=CHAOS_HYPS, refine_iters=2, polish_iters=1,
+                       frame_buckets=(CHAOS_BUCKET,), serve_max_wait_ms=2.0,
+                       serve_queue_depth=512)
+    hyps_per_request = M * CHAOS_HYPS
+
+    expert = ExpertNet(
+        scene_center=(0.0, 0.0, 0.0), stem_channels=preset.stem_channels,
+        head_channels=preset.head_channels, head_depth=preset.head_depth,
+        compute_dtype=jnp.float32,
+    )
+    gating = GatingNet(num_experts=M, channels=preset.gating_channels,
+                       compute_dtype=jnp.float32)
+    img0 = jnp.zeros((1, H, W, 3))
+
+    def write_scene(name, version, seed, nan=False):
+        e_params = jax.vmap(lambda k: expert.init(k, img0))(
+            jax.random.split(jax.random.key(seed), M)
+        )
+        if nan:
+            # Structurally valid, checksum-CONSISTENT, content-poisoned:
+            # only the health breaker stands between this and garbage.
+            e_params = jax.tree.map(
+                lambda x: np.full_like(x, np.nan), e_params
+            )
+        centers = (np.asarray([[0.0, 0.0, 2.0]], np.float32)
+                   + np.arange(M, dtype=np.float32)[:, None] * 0.1)
+        d = root / f"{name}_v{version}"
+        save_checkpoint(d / "expert", e_params, {
+            "stem_channels": list(preset.stem_channels),
+            "head_channels": preset.head_channels,
+            "head_depth": preset.head_depth,
+            "scene_centers": centers.tolist(),
+            "f": 40.0, "c": [W / 2.0, H / 2.0],
+        })
+        save_checkpoint(d / "gating",
+                        gating.init(jax.random.key(1000 + seed), img0),
+                        {"num_experts": M})
+        return compute_entry_checksums(SceneEntry(
+            scene_id=name, version=version,
+            expert_ckpt=str(d / "expert"), gating_ckpt=str(d / "gating"),
+            preset=preset, ransac=cfg,
+        ))
+
+    manifest = SceneManifest()
+    manifest.add(write_scene("s_ok", 1, seed=0))
+    manifest.add(write_scene("s_ok", 2, seed=10), activate=False)
+    manifest.add(write_scene("s_corrupt", 1, seed=1))
+    manifest.add(write_scene("s_ioflaky", 1, seed=2))
+    manifest.add(write_scene("s_nan", 1, seed=3))
+    manifest.add(write_scene("s_nan", 2, seed=13, nan=True), activate=False)
+    scenes = ["s_ok", "s_corrupt", "s_ioflaky", "s_nan"]
+
+    inj = FaultInjector()
+    loader = functools.partial(
+        load_scene_params,
+        read_checkpoint=inj.checkpoint_reader(load_checkpoint),
+        retries=2, backoff_s=0.02,
+    )
+    registry = SceneRegistry(
+        manifest, loader=loader,
+        health=HealthPolicy(window=16, min_samples=4, trip_bad_frac=0.5,
+                            canary_min_samples=8),
+    )
+
+    def frame(i):
+        return {
+            "key": jax.random.fold_in(jax.random.key(7), i),
+            "image": np.asarray(jax.random.uniform(
+                jax.random.fold_in(jax.random.key(42), i), (H, W, 3)
+            )),
+        }
+
+    pool = [frame(i) for i in range(8)]
+
+    # Prewarm: load every scene + the one shared compile, off the drill.
+    warmer = registry.dispatcher(cfg, start_worker=False)
+    for s in scenes:
+        warmer.infer_one(pool[0], scene=s)
+    compiled_before = registry.compile_cache_size()
+    walls = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        warmer.infer_many(pool[:CHAOS_BUCKET], scene="s_ok")
+        walls.append(time.perf_counter() - t0)
+    dispatch_s = sorted(walls)[len(walls) // 2]
+    capacity_rps = CHAOS_BUCKET / dispatch_s
+    deadline_ms = max(1_500.0, 20 * dispatch_s * 1e3)
+    slo = SLOPolicy(deadline_ms=deadline_ms,
+                    watchdog_ms=max(10_000.0, 50 * dispatch_s * 1e3),
+                    retry_max=1, quarantine_after=2)
+
+    disp = registry.dispatcher(cfg, slo=slo)
+    for i, s in enumerate(scenes):
+        disp.infer_one(pool[i], scene=s, deadline_ms=60_000.0)
+
+    def open_loop(n, seed):
+        return run_open_loop(
+            disp,
+            lambda i: (pool[i % len(pool)], scenes[i % len(scenes)], None),
+            poisson_arrivals(CHAOS_RATE_X * capacity_rps, n, seed=seed),
+            deadline_ms=deadline_ms,
+            hyps_per_request=hyps_per_request,
+        )
+
+    def per_scene(res):
+        """Per-scene (= per-fault-class) outcome + typed-error accounting
+        from the open-loop record; each scene's classes sum to its
+        offered — the acceptance invariant, asserted into the artifact."""
+        out = {}
+        outcomes = res["per_request_outcomes"]
+        errs = res["per_request_error_types"]
+        for i, o in enumerate(outcomes):
+            s = scenes[i % len(scenes)]
+            rec = out.setdefault(s, {
+                "offered": 0,
+                "outcomes": collections.Counter(),
+                "error_types": collections.Counter(),
+            })
+            rec["offered"] += 1
+            rec["outcomes"][o] += 1
+            if errs[i]:
+                rec["error_types"][errs[i]] += 1
+        for rec in out.values():
+            rec["outcomes"] = dict(rec["outcomes"])
+            rec["error_types"] = dict(rec["error_types"])
+            rec["sums_to_offered"] = (
+                sum(rec["outcomes"].values()) == rec["offered"]
+            )
+            good = (rec["outcomes"].get("served", 0)
+                    + rec["outcomes"].get("degraded", 0))
+            rec["goodput"] = round(good / max(rec["offered"], 1), 4)
+        return out
+
+    n_per_phase = int(min(max(32, CHAOS_RATE_X * capacity_rps * seconds), 400))
+    n_per_phase -= n_per_phase % len(scenes)  # equal per-scene offered
+
+    # ---- phase A: clean baseline under open-loop mixed-scene load ----
+    disp.reset_stats()
+    res_a = open_loop(n_per_phase, seed=11)
+    baseline = per_scene(res_a)
+
+    # ---- phase B: all three fault classes live under the same load ----
+    registry.cache.evict(("s_corrupt", 1))
+    inj.corrupt_loads(times=64, match=lambda p: "s_corrupt" in p)
+    registry.cache.evict(("s_ioflaky", 1))
+    inj.fail_loads(OSError("injected EIO"), times=2,
+                   match=lambda p: "s_ioflaky" in p)
+    t_promote = time.perf_counter()
+    registry.promote("s_nan", 2)  # the NaN-weight rollout
+    disp.reset_stats()
+    res_b = open_loop(n_per_phase, seed=23)
+    fault = per_scene(res_b)
+    totals_b = disp.slo_totals()
+    accounting_exact = (
+        all(rec["sums_to_offered"] for rec in fault.values())
+        and all(rec["sums_to_offered"] for rec in baseline.values())
+        and (totals_b["served"] + totals_b["shed"] + totals_b["expired"]
+             + totals_b["degraded"] + totals_b["failed"]
+             + totals_b["pending"] == totals_b["offered"])
+    )
+
+    health = registry.health()
+    rollback = next((e for e in health["events"]
+                     if e["event"] == "auto_rollback"
+                     and e["scene"] == "s_nan"), None)
+    nan_key = "s_nan@v2"
+    garbage_frames = health["scenes"].get(nan_key, {}).get("bad", 0)
+
+    # ---- recovery: operator clears the corrupt-checkpoint quarantine ----
+    inj.corrupt_loads(times=0)  # the "fixed checkpoint"
+    quarantined = [list(lane) for lane in disp.quarantined_lanes()]
+    t_release = time.perf_counter()
+    # The full operator recovery: clear the lane quarantine AND the
+    # scene breaker's failure samples (load failures feed the health
+    # window too, so a release that forgot the breaker would trip the
+    # scene on its first post-recovery serves).
+    disp.release_lane(scene="s_corrupt")
+    registry.release_scene("s_corrupt")
+    try:
+        disp.infer_one(pool[0], scene="s_corrupt", deadline_ms=60_000.0)
+        corrupt_recovered = True
+        corrupt_recovery_s = time.perf_counter() - t_release
+    except Exception:  # noqa: BLE001 — recorded, not raised
+        corrupt_recovered = False
+        corrupt_recovery_s = None
+
+    # ---- bit-identity: post-rollback s_nan == v1 loaded directly ----
+    probe = pool[3]
+    via_rollback = disp.infer_one(probe, scene="s_nan",
+                                  deadline_ms=60_000.0)
+    solo = SceneRegistry(SceneManifest())
+    solo.manifest.add(manifest.entry("s_nan", 1))
+    direct = solo.dispatcher(cfg, start_worker=False).infer_one(
+        probe, scene="s_nan"
+    )
+    bit_identical = all(
+        np.array_equal(np.asarray(via_rollback[k]), np.asarray(direct[k]))
+        for k in ("rvec", "tvec", "scores", "expert")
+    )
+
+    # ---- canary: healthy v2 of s_ok auto-finalizes ----
+    registry.promote("s_ok", 2, canary=0.5)
+    for i in range(24):
+        disp.infer_one(pool[i % len(pool)], scene="s_ok",
+                       deadline_ms=60_000.0)
+    canary_events = [e["event"] for e in registry.health()["events"]
+                     if e["event"].startswith("canary")]
+    canary_finalized = manifest.active_version("s_ok") == 2
+
+    compiled_after = registry.compile_cache_size()
+    disp.close()
+
+    return {
+        "scenes": {"n": len(scenes), "hw": [H, W], "num_experts": M,
+                   "n_hyps": CHAOS_HYPS, "frame_bucket": CHAOS_BUCKET},
+        "closed_loop_dispatch_ms": round(dispatch_s * 1e3, 2),
+        "offered_rps": round(CHAOS_RATE_X * capacity_rps, 2),
+        "offered_x_capacity": CHAOS_RATE_X,
+        "deadline_ms": round(deadline_ms, 1),
+        "offered_per_phase": n_per_phase,
+        "baseline": baseline,
+        "fault_window": {
+            "per_scene": fault,
+            "accounting_exact": bool(accounting_exact),
+            "dispatcher_totals": totals_b,
+            "healthy_goodput_retention": fault["s_ok"]["goodput"],
+        },
+        "faults": {
+            "corrupt_checkpoint": {
+                "scene": "s_corrupt",
+                "injected_corrupt_reads": inj.stats()["load_corruptions"],
+                "typed_errors": fault["s_corrupt"]["error_types"],
+                "quarantined_lanes": quarantined,
+                "released_and_recovered": bool(corrupt_recovered),
+                "recovery_latency_s": (
+                    round(corrupt_recovery_s, 4)
+                    if corrupt_recovery_s is not None else None
+                ),
+            },
+            "transient_io": {
+                "scene": "s_ioflaky",
+                "injected_failures": inj.stats()["load_failures"],
+                "goodput": fault["s_ioflaky"]["goodput"],
+                "retried_transparently": (
+                    fault["s_ioflaky"]["outcomes"].get("failed", 0) == 0
+                ),
+            },
+            "nan_weights": {
+                "scene": "s_nan",
+                "auto_rolled_back": rollback is not None,
+                "rollback_latency_s": (
+                    round(rollback["t"] - t_promote, 4)
+                    if rollback else None
+                ),
+                "active_version_after": manifest.active_version("s_nan"),
+                "garbage_frames_before_trip": int(garbage_frames),
+                "post_rollback_bit_identical": bool(bit_identical),
+            },
+        },
+        "canary": {
+            "scene": "s_ok", "fraction": 0.5,
+            "events": canary_events,
+            "finalized": bool(canary_finalized),
+            "active_version_after": manifest.active_version("s_ok"),
+        },
+        "compiled_programs": {
+            "before_faults": compiled_before,
+            "after_drill": compiled_after,
+            "hot_path_recompiles": compiled_after - compiled_before,
+        },
+        "health_events": [
+            {k: (round(v, 4) if isinstance(v, float) else v)
+             for k, v in e.items()}
+            for e in registry.health()["events"]
+        ],
+        "note": (
+            "open-loop mixed-scene Poisson load below the knee; per-scene "
+            "outcome classes sum exactly to offered (per fault class); "
+            "corrupt reads become typed ChecksumMismatchError failures + "
+            "lane quarantine (released by the operator after the fix); "
+            "transient IO faults are absorbed by the loader's capped "
+            "retry; the NaN-weight promote trips the health breaker, "
+            "which auto-rolls back to the previous version bit-identically "
+            "with zero recompiles; garbage_frames_before_trip counts "
+            "physical lanes (incl. padding) the bounded window served "
+            "before tripping; tiny scenes — fault routing, not throughput"
         ),
     }
 
@@ -1049,6 +1412,8 @@ def device_child(kwargs: dict) -> None:
         payload = {"loadtest": _measure_loadtest(**kwargs)}
     elif kwargs.pop("scoring", False):
         payload = {"scoring": _measure_scoring(**kwargs)}
+    elif kwargs.pop("chaos", False):
+        payload = {"chaos": _measure_chaos(**kwargs)}
     else:
         payload = {"rate": _measure_jax(**kwargs)}
     import jax
@@ -1394,39 +1759,42 @@ def main() -> None:
         _resume_pipelines(stopped)
 
 
-def _serve_main(stopped: list[int], load_before: list[float]) -> None:
-    """``python bench.py serve`` — the DESIGN.md §9 amortization curve,
-    wedge-safe like every other mode: the device leg runs in a detached
-    child (never killed), and on a wedged relay the curve is measured on
-    the CPU backend, flagged via "note".  Also records the dispatch-size
-    sweep artifact (.serve_amortization.json) with the same contention
-    pause + loadavg provenance as the throughput modes."""
+def _driver_main(stopped: list[int], load_before: list[float], *,
+                 key: str, what: str, measure_cpu, artifact_path,
+                 headline) -> None:
+    """ONE wedge-safe driver scaffold for every bench mode (TODO item 6:
+    the five near-verbatim per-mode copies are gone — a fallback or
+    provenance fix cannot silently miss a mode anymore).  The contract
+    the bench-guard canned tests pin, mode by mode:
+
+    - the device leg runs in a detached child (never killed); on a
+      wedged relay ``measure_cpu()`` re-measures on the CPU backend and
+      the JSON line says so via "note";
+    - ``headline(payload) -> dict`` contributes the mode's metric /
+      value / unit / vs_baseline + extras; the payload rides the line
+      under ``key``;
+    - contention pause + loadavg provenance, a crash-atomic
+      ``artifact_path`` (tmp + rename) carrying platform + recorded_at,
+      and exactly ONE JSON line on stdout.
+    """
     note = None
-    res = measure_on_device({"serve": True})
-    if res is None or "serve" not in res:
+    res = measure_on_device({key: True})
+    if res is None or key not in res:
         note = (
             "device measurement unavailable (relay wedged or child failed); "
-            "serve curve measured on CPU."
+            f"{what} measured on CPU."
         )
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        serve = _measure_serve()
+        payload = measure_cpu()
         platform, device_kind = "cpu", None
     else:
-        serve = res["serve"]
+        payload = res[key]
         platform, device_kind = res.get("platform"), res.get("device_kind")
         if platform == "cpu":
             note = "measurement child ran on CPU backend (no device visible)"
-    by_b = {e["frame_batch"]: e for e in serve["curve"]}
-    out = {
-        "metric": f"serve_hyps_per_sec_frame_batch_{max(by_b)}",
-        "value": by_b[max(by_b)]["hyps_per_s"],
-        "unit": "hyps/s",
-        "vs_baseline": None,
-        "vs_frame_batch_1": serve["amortization_x"],
-        "serve": serve,
-    }
+    out = {**headline(payload), key: payload}
     if note:
         out["note"] = note
     if device_kind:
@@ -1437,183 +1805,90 @@ def _serve_main(stopped: list[int], load_before: list[float]) -> None:
         "platform": platform,
         "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
     }
-    tmp = str(_SERVE_FILE) + ".tmp"
+    tmp = str(artifact_path) + ".tmp"
     with open(tmp, "w") as fh:
         json.dump(artifact, fh, indent=1)
-    os.replace(tmp, _SERVE_FILE)
+    os.replace(tmp, artifact_path)
     print(json.dumps(out))
 
 
-def _registry_main(stopped: list[int], load_before: list[float]) -> None:
-    """``python bench.py registry`` — multi-scene hot-swap latency classes
-    (DESIGN.md §10), wedge-safe like every other mode: the device leg runs
-    in a detached child (never killed), and on a wedged relay the sweep is
-    measured on the CPU backend, flagged via "note".  Records
-    .registry_swap.json with the same contention provenance."""
-    note = None
-    res = measure_on_device({"registry": True})
-    if res is None or "registry" not in res:
-        note = (
-            "device measurement unavailable (relay wedged or child failed); "
-            "registry sweep measured on CPU."
-        )
-        import jax
+def _serve_headline(serve: dict) -> dict:
+    by_b = {e["frame_batch"]: e for e in serve["curve"]}
+    return {
+        "metric": f"serve_hyps_per_sec_frame_batch_{max(by_b)}",
+        "value": by_b[max(by_b)]["hyps_per_s"],
+        "unit": "hyps/s",
+        "vs_baseline": None,
+        "vs_frame_batch_1": serve["amortization_x"],
+    }
 
-        jax.config.update("jax_platforms", "cpu")
-        registry = _measure_registry()
-        platform, device_kind = "cpu", None
-    else:
-        registry = res["registry"]
-        platform, device_kind = res.get("platform"), res.get("device_kind")
-        if platform == "cpu":
-            note = "measurement child ran on CPU backend (no device visible)"
-    out = {
+
+def _serve_main(stopped: list[int], load_before: list[float]) -> None:
+    """``python bench.py serve`` — the DESIGN.md §9 amortization curve
+    through the shared wedge-safe scaffold (.serve_amortization.json)."""
+    _driver_main(stopped, load_before, key="serve", what="serve curve",
+                 measure_cpu=lambda: _measure_serve(),
+                 artifact_path=_SERVE_FILE, headline=_serve_headline)
+
+
+def _registry_headline(registry: dict) -> dict:
+    return {
         "metric": "registry_hot_swap_p50_ms",
         "value": registry["hot_swap_ms"],
         "unit": "ms",
         "vs_baseline": None,
         "vs_warm_hit": registry["swap_over_warm_x"],
         "cold_over_warm_x": registry["cold_over_warm_x"],
-        "registry": registry,
     }
-    if note:
-        out["note"] = note
-    if device_kind:
-        out["device_kind"] = device_kind
-    out["contention"] = _contention_block(stopped, load_before)
-    artifact = {
-        **out,
-        "platform": platform,
-        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
-    }
-    tmp = str(_REGISTRY_FILE) + ".tmp"
-    with open(tmp, "w") as fh:
-        json.dump(artifact, fh, indent=1)
-    os.replace(tmp, _REGISTRY_FILE)
-    print(json.dumps(out))
 
 
-def _routed_main(stopped: list[int], load_before: list[float]) -> None:
-    """``python bench.py routed`` — the DESIGN.md §11 dense-vs-routed
-    serve sweep, wedge-safe like every other mode: the device leg runs in
-    a detached child (never killed), and on a wedged relay the sweep is
-    measured on the CPU backend, flagged via "note".  Records
-    .routed_serve.json with the same contention provenance."""
-    note = None
-    res = measure_on_device({"routed": True})
-    if res is None or "routed" not in res:
-        note = (
-            "device measurement unavailable (relay wedged or child failed); "
-            "routed sweep measured on CPU."
-        )
-        import jax
+def _registry_main(stopped: list[int], load_before: list[float]) -> None:
+    """``python bench.py registry`` — multi-scene hot-swap latency classes
+    (DESIGN.md §10) through the shared scaffold (.registry_swap.json)."""
+    _driver_main(stopped, load_before, key="registry", what="registry sweep",
+                 measure_cpu=lambda: _measure_registry(),
+                 artifact_path=_REGISTRY_FILE, headline=_registry_headline)
 
-        jax.config.update("jax_platforms", "cpu")
-        routed = _measure_routed()
-        platform, device_kind = "cpu", None
-    else:
-        routed = res["routed"]
-        platform, device_kind = res.get("platform"), res.get("device_kind")
-        if platform == "cpu":
-            note = "measurement child ran on CPU backend (no device visible)"
-    out = {
+
+def _routed_headline(routed: dict) -> dict:
+    return {
         "metric": "routed_serve_speedup_x_at_k_m4",
         "value": routed["speedup_at_k_m4"],
         "unit": "x",
         "vs_baseline": None,
         "k_eq_m_bitwise": routed["k_eq_m_bitwise"],
-        "routed": routed,
     }
-    if note:
-        out["note"] = note
-    if device_kind:
-        out["device_kind"] = device_kind
-    out["contention"] = _contention_block(stopped, load_before)
-    artifact = {
-        **out,
-        "platform": platform,
-        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
-    }
-    tmp = str(_ROUTED_FILE) + ".tmp"
-    with open(tmp, "w") as fh:
-        json.dump(artifact, fh, indent=1)
-    os.replace(tmp, _ROUTED_FILE)
-    print(json.dumps(out))
 
 
-def _scoring_main(stopped: list[int], load_before: list[float]) -> None:
-    """``python bench.py scoring`` — the ISSUE 8 n_hyps x scoring-impl
-    sweep, wedge-safe like every other mode: the device leg runs in a
-    detached child (never killed), and on a wedged relay the sweep is
-    measured on the CPU backend, flagged via "note".  Records
-    .scoring_fused.json with the same contention provenance."""
-    note = None
-    res = measure_on_device({"scoring": True})
-    if res is None or "scoring" not in res:
-        note = (
-            "device measurement unavailable (relay wedged or child failed); "
-            "scoring sweep measured on CPU."
-        )
-        import jax
+def _routed_main(stopped: list[int], load_before: list[float]) -> None:
+    """``python bench.py routed`` — the DESIGN.md §11 dense-vs-routed
+    serve sweep through the shared scaffold (.routed_serve.json)."""
+    _driver_main(stopped, load_before, key="routed", what="routed sweep",
+                 measure_cpu=lambda: _measure_routed(),
+                 artifact_path=_ROUTED_FILE, headline=_routed_headline)
 
-        jax.config.update("jax_platforms", "cpu")
-        scoring = _measure_scoring()
-        platform, device_kind = "cpu", None
-    else:
-        scoring = res["scoring"]
-        platform, device_kind = res.get("platform"), res.get("device_kind")
-        if platform == "cpu":
-            note = "measurement child ran on CPU backend (no device visible)"
+
+def _scoring_headline(scoring: dict) -> dict:
     top = scoring["curve"][-1]  # the largest-n_hyps point is the headline
-    out = {
+    return {
         "metric": f"scoring_fused_select_hyps_per_s_at_{top['n_hyps']}",
         "value": top["impls"]["fused_select"]["hyps_per_s"],
         "unit": "hyps/s",
         "vs_baseline": None,
         "fused_select_speedup_x_at_max": top["fused_select_speedup_x"],
         "winner_bit_identical_all": scoring["winner_bit_identical_all"],
-        "scoring": scoring,
     }
-    if note:
-        out["note"] = note
-    if device_kind:
-        out["device_kind"] = device_kind
-    out["contention"] = _contention_block(stopped, load_before)
-    artifact = {
-        **out,
-        "platform": platform,
-        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
-    }
-    tmp = str(_SCORING_FILE) + ".tmp"
-    with open(tmp, "w") as fh:
-        json.dump(artifact, fh, indent=1)
-    os.replace(tmp, _SCORING_FILE)
-    print(json.dumps(out))
 
 
-def _loadtest_main(stopped: list[int], load_before: list[float]) -> None:
-    """``python bench.py loadtest`` — the DESIGN.md §12 open-loop SLO
-    sweep, wedge-safe like every other mode: the device leg runs in a
-    detached child (never killed), and on a wedged relay the sweep is
-    measured on the CPU backend, flagged via "note".  Records
-    .serve_loadtest.json with the same contention provenance."""
-    note = None
-    res = measure_on_device({"loadtest": True})
-    if res is None or "loadtest" not in res:
-        note = (
-            "device measurement unavailable (relay wedged or child failed); "
-            "loadtest sweep measured on CPU."
-        )
-        import jax
+def _scoring_main(stopped: list[int], load_before: list[float]) -> None:
+    """``python bench.py scoring`` — the ISSUE 8 n_hyps x scoring-impl
+    sweep through the shared scaffold (.scoring_fused.json)."""
+    _driver_main(stopped, load_before, key="scoring", what="scoring sweep",
+                 measure_cpu=lambda: _measure_scoring(),
+                 artifact_path=_SCORING_FILE, headline=_scoring_headline)
 
-        jax.config.update("jax_platforms", "cpu")
-        loadtest = _measure_loadtest()
-        platform, device_kind = "cpu", None
-    else:
-        loadtest = res["loadtest"]
-        platform, device_kind = res.get("platform"), res.get("device_kind")
-        if platform == "cpu":
-            note = "measurement child ran on CPU backend (no device visible)"
+
+def _loadtest_headline(loadtest: dict) -> dict:
     # Headline: the dense, largest-bucket leg's knee (fall back to the
     # best-measured knee if that leg never reached goodput >= 0.99).
     legs = loadtest["legs"]
@@ -1626,46 +1901,57 @@ def _loadtest_main(stopped: list[int], load_before: list[float]) -> None:
     value = dense_big["knee_sustained_hyps_per_s"]
     if value is None:
         value = max(knees) if knees else None
-    out = {
+    return {
         "metric": "serve_loadtest_knee_sustained_hyps_per_s",
         "value": value,
         "unit": "hyps/s",
         "vs_baseline": None,
         "knee_offered_rps_dense_big_bucket": dense_big["knee_offered_rps"],
-        "loadtest": loadtest,
     }
-    if note:
-        out["note"] = note
-    if device_kind:
-        out["device_kind"] = device_kind
-    out["contention"] = _contention_block(stopped, load_before)
-    artifact = {
-        **out,
-        "platform": platform,
-        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+
+
+def _loadtest_main(stopped: list[int], load_before: list[float]) -> None:
+    """``python bench.py loadtest`` — the DESIGN.md §12 open-loop SLO
+    sweep through the shared scaffold (.serve_loadtest.json)."""
+    _driver_main(stopped, load_before, key="loadtest", what="loadtest sweep",
+                 measure_cpu=lambda: _measure_loadtest(),
+                 artifact_path=_LOADTEST_FILE, headline=_loadtest_headline)
+
+
+def _chaos_headline(chaos: dict) -> dict:
+    return {
+        "metric": "chaos_healthy_scene_goodput_retention",
+        "value": chaos["fault_window"]["healthy_goodput_retention"],
+        "unit": "goodput_ratio",
+        "vs_baseline": None,
+        "accounting_exact": chaos["fault_window"]["accounting_exact"],
+        "auto_rollback_latency_s":
+            chaos["faults"]["nan_weights"]["rollback_latency_s"],
+        "post_rollback_bit_identical":
+            chaos["faults"]["nan_weights"]["post_rollback_bit_identical"],
+        "hot_path_recompiles": chaos["compiled_programs"]["hot_path_recompiles"],
     }
-    tmp = str(_LOADTEST_FILE) + ".tmp"
-    with open(tmp, "w") as fh:
-        json.dump(artifact, fh, indent=1)
-    os.replace(tmp, _LOADTEST_FILE)
-    print(json.dumps(out))
+
+
+def _chaos_main(stopped: list[int], load_before: list[float]) -> None:
+    """``python bench.py chaos`` — the ISSUE 9 fleet fault-tolerance
+    drill (DESIGN.md §13) through the shared scaffold (.chaos_drill.json)."""
+    _driver_main(stopped, load_before, key="chaos", what="chaos drill",
+                 measure_cpu=lambda: _measure_chaos(),
+                 artifact_path=_CHAOS_FILE, headline=_chaos_headline)
 
 
 def _main_measured(stopped: list[int], load_before: list[float]) -> None:
-    if len(sys.argv) > 1 and sys.argv[1] == "serve":
-        _serve_main(stopped, load_before)
-        return
-    if len(sys.argv) > 1 and sys.argv[1] == "registry":
-        _registry_main(stopped, load_before)
-        return
-    if len(sys.argv) > 1 and sys.argv[1] == "routed":
-        _routed_main(stopped, load_before)
-        return
-    if len(sys.argv) > 1 and sys.argv[1] == "loadtest":
-        _loadtest_main(stopped, load_before)
-        return
-    if len(sys.argv) > 1 and sys.argv[1] == "scoring":
-        _scoring_main(stopped, load_before)
+    modes = {
+        "serve": _serve_main,
+        "registry": _registry_main,
+        "routed": _routed_main,
+        "loadtest": _loadtest_main,
+        "scoring": _scoring_main,
+        "chaos": _chaos_main,
+    }
+    if len(sys.argv) > 1 and sys.argv[1] in modes:
+        modes[sys.argv[1]](stopped, load_before)
         return
     streaming = len(sys.argv) > 1 and sys.argv[1] == "streaming"
     kwargs = (

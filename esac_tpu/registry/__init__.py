@@ -12,6 +12,13 @@ The scene-aware `serve.MicroBatchDispatcher` coalesces requests per
 """
 
 from esac_tpu.registry.cache import DeviceWeightCache, tree_nbytes
+from esac_tpu.registry.health import (
+    ChecksumMismatchError,
+    HealthPolicy,
+    SceneLoadError,
+    SceneUnhealthyError,
+    unhealthy_frames,
+)
 from esac_tpu.registry.manifest import (
     ManifestError,
     SceneEntry,
@@ -19,9 +26,11 @@ from esac_tpu.registry.manifest import (
     ScenePreset,
     entry_from_dict,
     entry_to_dict,
+    params_checksum,
 )
 from esac_tpu.registry.serving import (
     SceneRegistry,
+    compute_entry_checksums,
     load_scene_params,
     make_registry_sharded_serve_fn,
     make_routed_scene_bucket_fn,
@@ -29,17 +38,24 @@ from esac_tpu.registry.serving import (
 )
 
 __all__ = [
+    "ChecksumMismatchError",
     "DeviceWeightCache",
+    "HealthPolicy",
     "ManifestError",
     "SceneEntry",
+    "SceneLoadError",
     "SceneManifest",
     "ScenePreset",
     "SceneRegistry",
+    "SceneUnhealthyError",
+    "compute_entry_checksums",
     "entry_from_dict",
     "entry_to_dict",
     "load_scene_params",
     "make_registry_sharded_serve_fn",
     "make_routed_scene_bucket_fn",
     "make_scene_bucket_fn",
+    "params_checksum",
     "tree_nbytes",
+    "unhealthy_frames",
 ]

@@ -52,8 +52,16 @@ class DeviceWeightCache:
     ``loader(entry) -> host tree`` produces the weights (numpy leaves;
     registry/serving.load_scene_params is the shipped loader);
     ``budget_bytes=None`` disables eviction (everything stays resident).
-    Thread-safe: one lock covers lookup, load, staging and eviction, so
-    concurrent dispatch workers cannot double-load a scene.
+    Thread-safe, with the load OFF the instance lock (ISSUE 9): the lock
+    covers lookup, insertion and eviction, while ``loader(entry)`` +
+    ``device_put`` run under a per-key load future — so concurrent
+    dispatch workers still cannot double-load a scene (waiters block on
+    the owner's future), but one scene's slow, failing or outright
+    STALLED cold load can no longer wedge every other scene's warm hit
+    behind the cache lock (the fault-isolation property the scene health
+    drill relies on: a faulted scene degrades alone).  A failed load
+    caches nothing — the next request retries — and the failure is
+    counted (``load_failures``).
     """
 
     def __init__(
@@ -70,8 +78,16 @@ class DeviceWeightCache:
         self._lock = threading.Lock()
         self._trees: "collections.OrderedDict[Any, Any]" = collections.OrderedDict()
         self._nbytes: dict[Any, int] = {}
+        # key -> in-flight load future: {"event", "result", "error"}.
+        self._loading: dict[Any, dict] = {}
+        # Bumped by clear(): a load that straddles a clear still resolves
+        # its waiters (they get the tree) but must NOT re-insert into a
+        # cache the caller just emptied (review finding: the off-lock
+        # load made clear() resurrectable).
+        self._gen = 0
         self.hits = 0
         self.misses = 0
+        self.load_failures = 0
         # Bounded like the dispatcher's stats deques: a thrashing server
         # evicts per request for days — the recent window is the record,
         # the counter is the total.
@@ -82,7 +98,8 @@ class DeviceWeightCache:
 
     def get(self, entry) -> Any:
         """Device param tree for ``entry`` (anything with a ``.key``); loads
-        and stages on miss, evicting LRU entries until the budget holds."""
+        and stages on miss — outside the lock, under a per-key future —
+        evicting LRU entries until the budget holds."""
         import jax
 
         key = entry.key
@@ -91,16 +108,54 @@ class DeviceWeightCache:
                 self.hits += 1
                 self._trees.move_to_end(key)
                 return self._trees[key]
+            fut = self._loading.get(key)
+            if fut is None:
+                fut = self._loading[key] = {
+                    "event": threading.Event(), "result": None, "error": None,
+                }
+                owner = True
+            else:
+                owner = False
             self.misses += 1
+            gen = self._gen
+        if not owner:
+            # Another worker owns this key's load: wait for its future.
+            # The tree is handed over directly (not re-looked-up), so a
+            # racing eviction cannot turn a completed load into a miss.
+            fut["event"].wait()
+            if fut["error"] is not None:
+                raise fut["error"]
+            return fut["result"]
+        try:
             host = self._loader(entry)
             tree = (
                 jax.device_put(host, self._device)
                 if self._device is not None else jax.device_put(host)
             )
-            self._trees[key] = tree
-            self._nbytes[key] = tree_nbytes(tree)
-            self._evict_to_budget()
-            return tree
+            with self._lock:
+                if gen == self._gen:
+                    self._trees[key] = tree
+                    self._nbytes[key] = tree_nbytes(tree)
+                    self._evict_to_budget()
+                fut["result"] = tree
+                self._loading.pop(key, None)
+        except BaseException as e:
+            # ONE owner exit path for load, staging AND insertion faults:
+            # whatever raised, the future resolves and every waiter wakes
+            # typed — an un-set Event here would strand them forever on
+            # an untimed wait (the exact wedge class this repo bans).  A
+            # half-inserted entry is rolled back so a later get retries
+            # from a clean miss.
+            with self._lock:
+                self.load_failures += 1
+                fut["error"] = e
+                self._loading.pop(key, None)
+                self._trees.pop(key, None)
+                self._nbytes.pop(key, None)
+            fut["event"].set()
+            raise
+        fut["event"].set()
+        return tree
 
     def _evict_to_budget(self) -> None:
         if self._budget is None:
@@ -148,9 +203,13 @@ class DeviceWeightCache:
             return True
 
     def clear(self) -> None:
+        """Empty the cache.  In-flight loads still resolve their waiters
+        (callers get a usable tree) but land in the NEW generation as
+        misses — a cleared cache stays cleared."""
         with self._lock:
             self._trees.clear()
             self._nbytes.clear()
+            self._gen += 1
 
     def stats(self) -> dict:
         with self._lock:
@@ -161,4 +220,6 @@ class DeviceWeightCache:
                 "resident": len(self._trees),
                 "bytes_in_use": self._bytes_in_use(),
                 "budget_bytes": self._budget,
+                "load_failures": self.load_failures,
+                "loads_in_flight": len(self._loading),
             }

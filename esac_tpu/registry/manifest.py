@@ -29,6 +29,7 @@ Two design rules keep serving cheap and rollouts safe:
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
 import pathlib
@@ -38,6 +39,20 @@ from typing import Any
 from esac_tpu.ransac.config import RansacConfig
 
 FORMAT_VERSION = 1
+
+# Entry-level schema version (ISSUE 9): bumped when a SceneEntry grows
+# fields whose *absence of understanding* would change serving semantics.
+# v1 = the PR-3 shape; v2 adds content ``checksums`` + this field.  A
+# reader REJECTS entries declaring a newer schema (forward-compat
+# rejection: a manifest written by a newer esac_tpu may carry semantics —
+# e.g. a different checksum algorithm — this reader cannot verify, and
+# silently serving it is exactly the corrupt-scene hazard the checksums
+# exist to close).  Older manifests without the field hydrate with the
+# default and keep working (checksums stay optional).
+SCHEMA_VERSION = 2
+
+# Checkpoint roles a SceneEntry checksum may cover.
+CHECKSUM_ROLES = ("expert", "gating")
 
 
 class ManifestError(ValueError):
@@ -91,7 +106,17 @@ class ScenePreset:
 
 @dataclasses.dataclass(frozen=True)
 class SceneEntry:
-    """One immutable (scene, version) row of the manifest."""
+    """One immutable (scene, version) row of the manifest.
+
+    ``checksums`` (schema v2) pins the checkpoint CONTENT this entry was
+    authored against: sorted ``(role, sha256-hex)`` pairs over the loaded
+    param tree + config sidecar (:func:`params_checksum`), verified by
+    ``registry.serving.load_scene_params`` at load time so a corrupt or
+    swapped checkpoint becomes a typed ``ChecksumMismatchError`` instead
+    of silently-garbage poses.  ``None`` disables verification (legacy
+    entries).  ``schema_version`` records the writer's entry schema; see
+    ``SCHEMA_VERSION`` for the forward-compat rejection rule.
+    """
 
     scene_id: str
     version: int
@@ -99,14 +124,40 @@ class SceneEntry:
     preset: ScenePreset
     gating_ckpt: str | None = None
     ransac: RansacConfig = RansacConfig()
+    checksums: tuple[tuple[str, str], ...] | None = None
+    schema_version: int = SCHEMA_VERSION
 
     def __post_init__(self):
         if not self.scene_id or not isinstance(self.scene_id, str):
             raise ManifestError(f"bad scene_id {self.scene_id!r}")
-        if int(self.version) < 1:
+        # Strict int: a bool/float version (JSON `true` / `1.5`) used to
+        # hydrate by silent int() truncation — a version pointer that does
+        # not round-trip exactly is malformed, not approximately right.
+        if isinstance(self.version, bool) or not isinstance(self.version, int):
+            raise ManifestError(
+                f"{self.scene_id}: version {self.version!r} must be an "
+                "exact integer"
+            )
+        if self.version < 1:
             raise ManifestError(
                 f"{self.scene_id}: version {self.version} < 1"
             )
+        sv = self.schema_version
+        if isinstance(sv, bool) or not isinstance(sv, int) or sv < 1:
+            raise ManifestError(
+                f"{self.scene_id} v{self.version}: schema_version {sv!r} "
+                "must be an integer >= 1"
+            )
+        if sv > SCHEMA_VERSION:
+            raise ManifestError(
+                f"{self.scene_id} v{self.version}: entry schema_version "
+                f"{sv} is newer than this reader's {SCHEMA_VERSION} — the "
+                "manifest was written by a newer esac_tpu; refusing to "
+                "serve semantics this reader cannot verify"
+            )
+        object.__setattr__(
+            self, "checksums", _normalize_checksums(self)
+        )
         if self.preset.gated != (self.gating_ckpt is not None):
             raise ManifestError(
                 f"{self.scene_id} v{self.version}: preset.gated="
@@ -114,6 +165,11 @@ class SceneEntry:
                 f"{self.gating_ckpt!r} — a gated scene needs a gating "
                 "checkpoint and vice versa"
             )
+
+    @property
+    def checksum_map(self) -> dict[str, str]:
+        """``{role: sha256-hex}`` view of ``checksums`` ({} when unset)."""
+        return dict(self.checksums) if self.checksums else {}
 
     @property
     def key(self) -> tuple[str, int]:
@@ -125,6 +181,88 @@ class SceneEntry:
         when hot-swapped (registry/serving.py builds one jitted fn per
         bucket key; params are traced arguments)."""
         return (self.preset, self.ransac)
+
+
+def _normalize_checksums(entry: "SceneEntry"):
+    """Validate + canonicalize an entry's ``checksums`` field: sorted
+    tuple of (role, 64-hex-sha256) string pairs (JSON round-trips the
+    inner pairs as lists), roles limited to the entry's checkpoints."""
+    raw = entry.checksums
+    if raw is None:
+        return None
+    what = f"{entry.scene_id} v{entry.version}"
+    if isinstance(raw, dict):
+        raw = sorted(raw.items())
+    try:
+        items = [tuple(item) for item in raw]
+    except TypeError:
+        raise ManifestError(
+            f"{what}: checksums must be (role, sha256) pairs, got {raw!r}"
+        ) from None
+    out = []
+    for item in items:
+        if len(item) != 2 or not all(isinstance(x, str) for x in item):
+            raise ManifestError(
+                f"{what}: checksum entry {item!r} is not a "
+                "(role, sha256-hex) string pair"
+            )
+        role, digest = item
+        if role not in CHECKSUM_ROLES:
+            raise ManifestError(
+                f"{what}: unknown checksum role {role!r} "
+                f"(valid: {CHECKSUM_ROLES})"
+            )
+        if role == "gating" and entry.gating_ckpt is None:
+            raise ManifestError(
+                f"{what}: gating checksum on an ungated entry"
+            )
+        if len(digest) != 64 or any(
+            c not in "0123456789abcdef" for c in digest.lower()
+        ):
+            raise ManifestError(
+                f"{what}: checksum for {role!r} is not 64-hex sha256: "
+                f"{digest!r}"
+            )
+        out.append((role, digest.lower()))
+    if len({r for r, _ in out}) != len(out):
+        raise ManifestError(f"{what}: duplicate checksum role")
+    return tuple(sorted(out))
+
+
+def params_checksum(params: Any, config: dict | None = None) -> str:
+    """Content sha256 of a LOADED checkpoint: every array leaf of the
+    param tree (deterministic sorted-key traversal: path + shape + dtype
+    + raw bytes) plus the canonical-JSON config sidecar.
+
+    Hashing the loaded values — not the on-disk files — makes the digest
+    independent of the Orbax layout (stable across the version drift this
+    repo has already survived) and places verification AFTER the whole
+    read path, so corruption anywhere between disk and host memory is
+    caught.  Pure numpy/hashlib: importable without jax (manifest code
+    must never init a device backend).
+    """
+    import numpy as np
+
+    h = hashlib.sha256()
+
+    def walk(prefix: str, node: Any) -> None:
+        if isinstance(node, dict):
+            for k in sorted(node):
+                walk(f"{prefix}/{k}", node[k])
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                walk(f"{prefix}/{i}", v)
+        else:
+            arr = np.asarray(node)
+            h.update(prefix.encode())
+            h.update(f"|{arr.shape}|{arr.dtype.str}|".encode())
+            h.update(np.ascontiguousarray(arr).tobytes())
+
+    walk("", params)
+    if config is not None:
+        h.update(b"||config||")
+        h.update(json.dumps(config, sort_keys=True).encode())
+    return h.hexdigest()
 
 
 # ---------------- (de)serialization ----------------
@@ -227,6 +365,30 @@ class SceneManifest:
             except KeyError:
                 raise ManifestError(f"unknown scene {scene_id!r}") from None
 
+    def entry(self, scene_id: str, version: int) -> SceneEntry:
+        """A specific registered (scene, version) row — the canary
+        resolution path (registry.serving routes a traffic fraction to a
+        NOT-yet-active version without moving the active pointer)."""
+        with self._lock:
+            try:
+                return self._entries[(scene_id, version)]
+            except KeyError:
+                raise ManifestError(
+                    f"no entry {scene_id!r} v{version}"
+                ) from None
+
+    def active_version(self, scene_id: str) -> int:
+        with self._lock:
+            try:
+                return self._active[scene_id]
+            except KeyError:
+                raise ManifestError(f"unknown scene {scene_id!r}") from None
+
+    def previous_version(self, scene_id: str) -> int | None:
+        """The one-step rollback target, or None (no last-known-good)."""
+        with self._lock:
+            return self._previous.get(scene_id)
+
     # ---- rollout ----
 
     def promote(self, scene_id: str, version: int) -> SceneEntry:
@@ -307,9 +469,15 @@ class SceneManifest:
             )
         unknown = set(data) - {"format_version", "scenes"}
         if unknown:
-            raise ManifestError(f"manifest: unknown field(s) {sorted(unknown)}")
+            raise ManifestError(
+                f"manifest: unknown field(s) {sorted(unknown)} — written "
+                "by a newer esac_tpu?  This reader supports format_version "
+                f"{FORMAT_VERSION} / entry schema_version <= {SCHEMA_VERSION}"
+            )
         m = cls()
-        scenes = data.get("scenes", {})
+        if "scenes" not in data:
+            raise ManifestError("manifest: missing scenes table")
+        scenes = data["scenes"]
         if not isinstance(scenes, dict):
             raise ManifestError("manifest.scenes: expected an object")
         for sid, rec in scenes.items():
@@ -333,18 +501,20 @@ class SceneManifest:
                 m._entries[entry.key] = entry
 
             def pointer(name):
-                """An int version pointer or None; non-numeric is malformed,
-                not a crash (the strict ManifestError contract)."""
+                """An int version pointer or None; anything else is
+                malformed, not a crash (the strict ManifestError
+                contract).  Strict: a bool/float pointer (JSON `true`,
+                `1.7`) used to round-trip by silent int() truncation —
+                the ISSUE-9 silent-acceptance gap."""
                 val = rec.get(name)
                 if val is None:
                     return None
-                try:
-                    return int(val)
-                except (TypeError, ValueError):
+                if isinstance(val, bool) or not isinstance(val, int):
                     raise ManifestError(
                         f"scene {sid!r}: {name} version {val!r} is not an "
-                        "integer"
-                    ) from None
+                        "exact integer"
+                    )
+                return val
 
             active = pointer("active")
             if active is None or (sid, active) not in m._entries:

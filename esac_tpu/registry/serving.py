@@ -21,22 +21,90 @@ subsequent dispatch of that scene.
 
 from __future__ import annotations
 
+import collections
+import dataclasses
 import threading
+import time
 
 import numpy as np
 
 from esac_tpu.ransac.config import RansacConfig
 from esac_tpu.registry.cache import DeviceWeightCache
+from esac_tpu.registry.health import (
+    ChecksumMismatchError,
+    HealthPolicy,
+    SceneLoadError,
+    SceneUnhealthyError,
+    unhealthy_frames,
+)
 from esac_tpu.registry.manifest import (
     ManifestError,
     SceneEntry,
     SceneManifest,
     ScenePreset,
+    params_checksum,
 )
 from esac_tpu.utils.checkpoint import load_checkpoint
 
+# Capped retry/backoff for transient checkpoint-read faults (OSError:
+# flaky NFS, a mid-rotation file, an interrupted read).  Two retries at
+# 50ms/100ms bound the added cold-load latency to ~150ms worst case —
+# small against the measured 29ms..seconds cold-load + compile costs —
+# while absorbing the single-blip faults that should never surface as a
+# failed dispatch.
+LOAD_RETRIES = 2
+LOAD_BACKOFF_S = 0.05
 
-def load_scene_params(entry: SceneEntry) -> dict:
+
+def _read_with_retry(path, what, read_checkpoint, retries, backoff_s):
+    """``load_checkpoint`` with capped retry/backoff on transient IO
+    faults.  OSError is the transient class (retried); anything else —
+    an unparsable sidecar, a truncated Orbax tree — is deterministic and
+    wraps immediately into a typed, non-retryable SceneLoadError."""
+    read = read_checkpoint if read_checkpoint is not None else load_checkpoint
+    attempt = 0
+    while True:
+        try:
+            return read(path)
+        except OSError as e:
+            attempt += 1
+            if attempt > retries:
+                raise SceneLoadError(
+                    f"{what}: checkpoint {path!r} failed to load after "
+                    f"{attempt} attempts (last: {e!r})"
+                ) from e
+            time.sleep(min(backoff_s * (2 ** (attempt - 1)), 1.0))
+        except (SceneLoadError, ManifestError):
+            raise
+        except Exception as e:  # noqa: BLE001 — typed boundary
+            raise SceneLoadError(
+                f"{what}: checkpoint {path!r} is unreadable "
+                f"(not transient: {e!r})"
+            ) from e
+
+
+def _verify_checksum(entry, role, params, config):
+    """Compare loaded content against the manifest's recorded checksum
+    for ``role`` (no-op when the entry carries none)."""
+    want = entry.checksum_map.get(role)
+    if want is None:
+        return
+    got = params_checksum(params, config)
+    if got != want:
+        raise ChecksumMismatchError(
+            f"{entry.scene_id} v{entry.version}: {role} checkpoint content "
+            f"hash {got[:12]}… != manifest {want[:12]}… — corrupt or "
+            "swapped weights; refusing to serve them"
+        )
+
+
+def load_scene_params(
+    entry: SceneEntry,
+    *,
+    retries: int = LOAD_RETRIES,
+    backoff_s: float = LOAD_BACKOFF_S,
+    read_checkpoint=None,
+) -> dict:
     """Default weight-cache loader: checkpoint dirs -> one host param tree.
 
     Reads the expert (and, for gated presets, gating) checkpoints through
@@ -47,14 +115,26 @@ def load_scene_params(entry: SceneEntry) -> dict:
     at LOAD time with a precise error, not at dispatch time with a shape
     mismatch deep inside jit.
 
+    Fault model (ISSUE 9): transient IO faults are retried with capped
+    backoff (``retries``/``backoff_s``) and surface as a typed
+    :class:`~esac_tpu.registry.health.SceneLoadError` only once
+    exhausted; when the entry carries content ``checksums``, the loaded
+    tree+config must hash back to them or the load fails with a typed
+    :class:`~esac_tpu.registry.health.ChecksumMismatchError` — corrupt
+    weights are never handed to a compiled program.  ``read_checkpoint``
+    overrides the checkpoint reader (the FaultInjector drill hook).
+
     The tree's leaves: ``expert`` (M-stacked variables), ``gating`` (gated
     presets only), ``centers`` (M, 3) per-expert scene centers, ``c`` (2,)
     principal point, ``f`` () focal — everything a bucket fn needs beyond
     the request itself.
     """
     p = entry.preset
-    params_e, cfg_e = load_checkpoint(entry.expert_ckpt)
     what = f"{entry.scene_id} v{entry.version}"
+    params_e, cfg_e = _read_with_retry(
+        entry.expert_ckpt, what, read_checkpoint, retries, backoff_s
+    )
+    _verify_checksum(entry, "expert", params_e, cfg_e)
     for field in ("stem_channels", "head_channels", "head_depth"):
         want = getattr(p, field)
         got = cfg_e.get(field)
@@ -89,7 +169,10 @@ def load_scene_params(entry: SceneEntry) -> dict:
         "f": np.float32(cfg_e["f"]),
     }
     if p.gated:
-        params_g, cfg_g = load_checkpoint(entry.gating_ckpt)
+        params_g, cfg_g = _read_with_retry(
+            entry.gating_ckpt, what, read_checkpoint, retries, backoff_s
+        )
+        _verify_checksum(entry, "gating", params_g, cfg_g)
         if int(cfg_g.get("num_experts", -1)) != p.num_experts:
             raise ManifestError(
                 f"{what}: gating checkpoint num_experts="
@@ -97,6 +180,19 @@ def load_scene_params(entry: SceneEntry) -> dict:
             )
         tree["gating"] = params_g
     return tree
+
+
+def compute_entry_checksums(entry: SceneEntry,
+                            read_checkpoint=None) -> SceneEntry:
+    """Author-side helper: load the entry's checkpoints once and return
+    the entry with content ``checksums`` recorded — run it when
+    registering a version, so every later load verifies against the
+    content that was actually reviewed."""
+    read = read_checkpoint if read_checkpoint is not None else load_checkpoint
+    sums = [("expert", params_checksum(*read(entry.expert_ckpt)))]
+    if entry.gating_ckpt is not None:
+        sums.append(("gating", params_checksum(*read(entry.gating_ckpt))))
+    return dataclasses.replace(entry, checksums=tuple(sums))
 
 
 def _tree_leaves(tree):
@@ -290,6 +386,27 @@ class SceneRegistry:
     device weights **per dispatch** — which is exactly what gives
     promote/rollback their drain semantics: a dispatch in flight keeps the
     entry and params it resolved; the next dispatch sees the new pointer.
+
+    Scene health (ISSUE 9, DESIGN.md §13): with a
+    :class:`~esac_tpu.registry.health.HealthPolicy` (the default), every
+    dispatch's winner is scored into a per-(scene, version) circuit
+    breaker — evaluated one dispatch DEFERRED, so the probe reads
+    long-materialized values and never stalls in-flight compute.  A
+    version whose recent window goes bad (non-finite poses: NaN weights,
+    a poisoned checkpoint) trips: the scene **auto-rolls back** to the
+    manifest's previous version when one exists (a pointer swap — same
+    preset, same compiled programs, zero recompiles, results
+    bit-identical to loading that version directly) or sheds typed
+    (:class:`~esac_tpu.registry.health.SceneUnhealthyError`) until an
+    operator :meth:`release_scene`\\ s it.  :meth:`promote` with
+    ``canary=`` routes a bounded fraction of the scene's traffic to the
+    new version, compares its health against the incumbent and
+    auto-finalizes or auto-rolls back — the active pointer never moves
+    until the canary earns it.  All health state lives under one
+    instance lock (graft-lint R10); pointer/cache actions derived from a
+    trip are executed OUTSIDE it (single-shot, guarded by the tripped
+    set) to keep the lock order registry-health -> manifest/cache free
+    of cycles.
     """
 
     def __init__(
@@ -298,11 +415,25 @@ class SceneRegistry:
         budget_bytes: int | None = None,
         loader=load_scene_params,
         device=None,
+        health: HealthPolicy | None = HealthPolicy(),
+        clock=time.perf_counter,
     ):
         self.manifest = manifest
         self.cache = DeviceWeightCache(loader, budget_bytes, device)
         self._fns: dict = {}
         self._fns_lock = threading.Lock()
+        self._health_policy = health
+        self._clock = clock
+        self._health_lock = threading.Lock()
+        # Deferred probes: (key, {leaf name: device array}) per dispatch.
+        self._probes: collections.deque = collections.deque()
+        # key -> deque[(bad, total)] over the last `window` dispatches.
+        self._samples: dict = {}
+        self._tripped: dict = {}           # key -> reason
+        self._canaries: dict = {}          # scene -> canary state dict
+        self.health_events: collections.deque = collections.deque(
+            maxlen=(health.events_window if health else 1)
+        )
 
     def _fn_for(self, entry: SceneEntry, route_k: int | None = None,
                 n_hyps: int | None = None):
@@ -316,8 +447,6 @@ class SceneRegistry:
         manifest entry.  Programs are cached per (bucket key, K, n_hyps) —
         scenes sharing preset+cfg share every program, so hot-swap stays
         recompile-free at every (K, n_hyps)."""
-        import dataclasses
-
         if route_k is None and entry.ransac.serve_topk > 0:
             route_k = entry.ransac.serve_topk
         if n_hyps is not None and n_hyps < 1:
@@ -344,20 +473,386 @@ class SceneRegistry:
                 self._fns[key] = fn
             return fn
 
+    @staticmethod
+    def _batch_frames(batch) -> int:
+        """Leading-axis frame count of a dispatch batch tree — the
+        weight of its health sample.  Frames-major contract: every
+        shaped leaf shares the frame axis; the named leaves are
+        preferred so an old-style raw PRNG key (shape (2,) unstacked)
+        can never masquerade as the frame count.  1 when nothing is
+        shaped (a failure sample must never weigh 0)."""
+        leaves = [batch]
+        if isinstance(batch, dict):
+            named = [batch[k] for k in ("image", "coords_all", "pixels")
+                     if k in batch]
+            leaves = named + list(batch.values())
+        for leaf in leaves:
+            shp = getattr(leaf, "shape", None)
+            if shp:
+                return int(shp[0])
+        return 1
+
     def infer_fn(self):
         """The dispatcher-facing callable: ``fn(batch, scene[, route_k])``
         — ``route_k`` selects the top-K routed program for the dispatch
         (None = the scene's default: dense, or ``cfg.serve_topk``);
         ``n_hyps`` (keyword-only) selects a hypothesis-budget override
-        program (see :meth:`_fn_for`)."""
+        program (see :meth:`_fn_for`).  With a health policy, each call
+        first settles the previous dispatches' health probes (trips,
+        rollbacks and canary decisions land here, BETWEEN dispatches),
+        resolves through the breaker/canary, and enqueues this
+        dispatch's probe."""
 
         def serve(batch, scene, route_k=None, n_hyps=None):
-            entry = self.manifest.resolve(scene)
-            params = self.cache.get(entry)
-            return self._fn_for(entry, route_k, n_hyps)(params, batch)
+            if self._health_policy is None:
+                entry = self.manifest.resolve(scene)
+                params = self.cache.get(entry)
+                return self._fn_for(entry, route_k, n_hyps)(params, batch)
+            self._drain_probes()
+            entry = self._resolve_serving(scene)
+            # Program resolution FIRST, outside the health-sampled
+            # region: a bad caller override (n_hyps=0, an invalid
+            # route_k) raises here and is the CALLER's fault — sampling
+            # it would let one misbehaving client trip a healthy
+            # version's breaker.
+            fn = self._fn_for(entry, route_k, n_hyps)
+            try:
+                params = self.cache.get(entry)
+                out = fn(params, batch)
+            except Exception:
+                # A dispatch that fails on the VERSION's own surface —
+                # load fault, checksum mismatch, program execution — IS
+                # a health signal: without this, a canary whose
+                # checkpoint cannot even load would never accumulate
+                # probes and the canary would dangle forever (review
+                # finding) — and an active version that stops loading
+                # could never earn its auto-rollback.  The sample weighs
+                # the dispatch's FRAME count so it carries the same unit
+                # as a healthy probe (which weighs bucket-size frames).
+                self._record_failure_sample(entry.key,
+                                            self._batch_frames(batch))
+                raise
+            self._enqueue_probe(entry.key, out)
+            return out
 
         serve._cache_size = self.compile_cache_size
         return serve
+
+    # ---------------- scene health: breaker + canary (DESIGN.md §13) ----
+
+    def promote(self, scene_id: str, version: int, canary: float | None = None):
+        """Point a scene at ``version``.  ``canary=None`` is the atomic
+        manifest promote (PR-3 semantics, byte-for-byte).  With
+        ``canary`` in (0, 1), the ACTIVE pointer does not move: that
+        fraction of the scene's subsequent dispatches resolves the new
+        version instead, its health is compared against the incumbent
+        once ``canary_min_samples`` frames landed, and the canary
+        auto-finalizes (manifest promote) or auto-rolls back (the route
+        is dropped; the incumbent never left).  ``release_scene`` is the
+        operator override.
+
+        Either path refuses a version whose breaker is TRIPPED: moving
+        the pointer onto known-bad weights would shed every dispatch
+        typed AND quarantine the lane — a routine re-promote after a fix
+        must go through ``release_scene`` first, which is where the
+        operator asserts the fix actually happened.  (Direct
+        ``manifest.promote`` bypasses this guard — it is the raw
+        pointer-swap primitive; the registry facade is the one that
+        knows about health.)
+
+        A plain promote also refuses while the scene has a canary in
+        flight: the canary's eventual finalize is a ``manifest.promote``
+        of ITS version, so a pointer moved underneath it would be
+        silently reverted when the stale canary wins its health
+        comparison — ``release_scene`` cancels the canary first, which
+        makes the operator's intent explicit."""
+        if canary is None:
+            with self._health_lock:
+                reason = self._tripped.get((scene_id, version))
+                inflight = self._canaries.get(scene_id)
+            if inflight is not None:
+                raise ManifestError(
+                    f"{scene_id!r} has a canary in flight "
+                    f"(v{inflight['version']}); release_scene() to cancel "
+                    "it before moving the pointer — a stale canary "
+                    "finalizing later would silently revert this promote"
+                )
+            if reason is not None:
+                raise ManifestError(
+                    f"{scene_id!r} v{version} is breaker-tripped "
+                    f"({reason}); release_scene() it before re-promoting"
+                )
+            return self.manifest.promote(scene_id, version)
+        if self._health_policy is None:
+            raise ManifestError(
+                "canary promotion needs a HealthPolicy (the canary's "
+                "verdict IS its health record)"
+            )
+        if not 0.0 < canary < 1.0:
+            raise ValueError(f"canary fraction {canary} outside (0, 1)")
+        entry = self.manifest.entry(scene_id, version)
+        incumbent = self.manifest.active_version(scene_id)
+        if incumbent == version:
+            raise ManifestError(
+                f"{scene_id!r} v{version} is already active — nothing to "
+                "canary"
+            )
+        with self._health_lock:
+            if scene_id in self._canaries:
+                raise ManifestError(
+                    f"{scene_id!r} already has a canary in flight "
+                    f"(v{self._canaries[scene_id]['version']})"
+                )
+            if (scene_id, version) in self._tripped:
+                raise ManifestError(
+                    f"{scene_id!r} v{version} is breaker-tripped; "
+                    "release_scene() it before re-promoting"
+                )
+            self._canaries[scene_id] = {
+                "version": version, "incumbent": incumbent,
+                "fraction": float(canary), "count": 0,
+                "t_start": self._clock(),
+            }
+            self.health_events.append({
+                "t": self._clock(), "event": "canary_start",
+                "scene": scene_id, "version": version,
+                "incumbent": incumbent, "fraction": float(canary),
+            })
+        return entry
+
+    def release_scene(self, scene_id: str, version: int | None = None) -> None:
+        """Operator override mirroring ``release_lane``: clear the
+        breaker state (and stats) for a scene — one version or all — and
+        cancel its in-flight canary, after the underlying fault (fixed
+        checkpoint, recovered storage) is resolved."""
+        with self._health_lock:
+            for key in [k for k in self._tripped
+                        if k[0] == scene_id
+                        and (version is None or k[1] == version)]:
+                del self._tripped[key]
+            for key in [k for k in self._samples
+                        if k[0] == scene_id
+                        and (version is None or k[1] == version)]:
+                del self._samples[key]
+            c = self._canaries.get(scene_id)
+            if c is not None and (version is None or c["version"] == version):
+                del self._canaries[scene_id]
+                self.health_events.append({
+                    "t": self._clock(), "event": "canary_cancelled",
+                    "scene": scene_id, "version": c["version"],
+                    "incumbent": c["incumbent"],
+                })
+
+    def health(self, drain: bool = True) -> dict:
+        """Locked snapshot of the breaker: per-(scene, version) window
+        stats + trip reasons (keyed ``"<scene>@v<version>"`` — the whole
+        snapshot is json.dumps-able, the driver/monitor contract), the
+        in-flight canaries, and the bounded event log.  ``drain``
+        settles pending probes first (the default — a monitor wants the
+        truth as of the last completed dispatch)."""
+        if drain and self._health_policy is not None:
+            self._drain_probes()
+        with self._health_lock:
+            scenes = {}
+            for key, dq in self._samples.items():
+                tot = sum(t for _, t in dq)
+                bad = sum(b for b, _ in dq)
+                scenes[f"{key[0]}@v{key[1]}"] = {
+                    "scene": key[0], "version": key[1],
+                    "frames": tot, "bad": bad,
+                    "bad_frac": (bad / tot) if tot else 0.0,
+                    "tripped": self._tripped.get(key),
+                }
+            for key, reason in self._tripped.items():
+                scenes.setdefault(f"{key[0]}@v{key[1]}", {
+                    "scene": key[0], "version": key[1],
+                    "frames": 0, "bad": 0, "bad_frac": 0.0,
+                    "tripped": reason,
+                })
+            return {
+                "scenes": scenes,
+                "canaries": {s: dict(c) for s, c in self._canaries.items()},
+                "events": [dict(e) for e in self.health_events],
+            }
+
+    def _enqueue_probe(self, key, out) -> None:
+        """Stash this dispatch's winner leaves for DEFERRED health
+        evaluation (next serve/health call — by then the values are
+        materialized and the np.asarray sync is free)."""
+        leaves = {k: out[k] for k in ("rvec", "tvec", "inlier_frac")
+                  if k in out}
+        if not leaves:
+            return
+        with self._health_lock:
+            self._probes.append((key, leaves))
+
+    def _drain_probes(self) -> None:
+        """Settle pending probes: evaluate (device sync OUTSIDE the
+        health lock), fold into the per-key windows, and execute any
+        trip/rollback/canary action exactly once."""
+        with self._health_lock:
+            if not self._probes:
+                return
+            pending = list(self._probes)
+            self._probes.clear()
+        evaluated = [
+            (key, *unhealthy_frames(leaves)) for key, leaves in pending
+        ]
+        actions = []
+        with self._health_lock:
+            for key, bad, total in evaluated:
+                dq = self._samples.get(key)
+                if dq is None:
+                    dq = self._samples[key] = collections.deque(
+                        maxlen=self._health_policy.window
+                    )
+                dq.append((bad, total))
+                action = self._judge_locked(key)
+                if action is not None:
+                    actions.append(action)
+        for action in actions:
+            self._act(action)
+
+    def _record_failure_sample(self, key, frames: int = 1) -> None:
+        """Fold one FAILED dispatch of ``key`` into its health window as
+        ``frames`` all-bad frames, and execute any resulting trip action
+        — the same judge/act path a probe takes, so load-dead versions
+        trip, roll back, and resolve canaries exactly like NaN ones.
+        ``frames`` is the dispatch's frame count: healthy probes weigh
+        bucket-size frames, so a failure weighed (1, 1) would be diluted
+        ~bucket-fold at large buckets and an intermittently load-dead
+        scene could never reach ``trip_bad_frac`` (review finding)."""
+        frames = max(1, int(frames))
+        with self._health_lock:
+            dq = self._samples.get(key)
+            if dq is None:
+                dq = self._samples[key] = collections.deque(
+                    maxlen=self._health_policy.window
+                )
+            dq.append((frames, frames))
+            action = self._judge_locked(key)
+        if action is not None:
+            self._act(action)
+
+    def _judge_locked(self, key):
+        """Breaker/canary verdict for ``key`` after a new sample (health
+        lock held).  Mutates trip/canary STATE here — single-shot, so
+        racing drains cannot double-act — and returns the pointer/cache
+        action to execute outside the lock, or None."""
+        pol = self._health_policy
+        scene, version = key
+        dq = self._samples[key]
+        tot = sum(t for _, t in dq)
+        bad = sum(b for b, _ in dq)
+        frac = (bad / tot) if tot else 0.0
+        canary = self._canaries.get(scene)
+        is_canary = canary is not None and canary["version"] == version
+        if (key not in self._tripped and tot >= pol.min_samples
+                and frac >= pol.trip_bad_frac):
+            self._tripped[key] = (
+                f"{bad}/{tot} unhealthy winner frames "
+                f"(bad_frac {frac:.2f} >= {pol.trip_bad_frac})"
+            )
+            if is_canary:
+                del self._canaries[scene]
+                return {"kind": "canary_rollback", "scene": scene,
+                        "version": version, "bad_frac": frac,
+                        "incumbent": canary["incumbent"]}
+            try:
+                active = self.manifest.active_version(scene)
+            except ManifestError:
+                active = None
+            prev = self.manifest.previous_version(scene)
+            if (version == active and pol.auto_rollback and prev is not None
+                    and (scene, prev) not in self._tripped):
+                return {"kind": "auto_rollback", "scene": scene,
+                        "version": version, "bad_frac": frac}
+            return {"kind": "tripped", "scene": scene, "version": version,
+                    "bad_frac": frac}
+        if is_canary and tot >= pol.canary_min_samples:
+            idq = self._samples.get((scene, canary["incumbent"]))
+            itot = sum(t for _, t in idq) if idq else 0
+            ibad = sum(b for b, _ in idq) if idq else 0
+            ifrac = (ibad / itot) if itot else 0.0
+            del self._canaries[scene]
+            if frac <= ifrac + pol.canary_bad_slack:
+                return {"kind": "canary_promote", "scene": scene,
+                        "version": version, "bad_frac": frac,
+                        "incumbent": canary["incumbent"],
+                        "incumbent_bad_frac": ifrac}
+            self._tripped[key] = (
+                f"canary bad_frac {frac:.2f} > incumbent {ifrac:.2f} "
+                f"+ slack {pol.canary_bad_slack}"
+            )
+            return {"kind": "canary_rollback", "scene": scene,
+                    "version": version, "bad_frac": frac,
+                    "incumbent": canary["incumbent"],
+                    "incumbent_bad_frac": ifrac}
+        return None
+
+    def _act(self, action) -> None:
+        """Execute one judged action (health lock NOT held — manifest and
+        cache take their own locks; single-shot guaranteed by the
+        state mutations _judge_locked already made)."""
+        kind = action.pop("kind")
+        scene, version = action["scene"], action["version"]
+        if kind == "auto_rollback":
+            try:
+                entry = self.manifest.rollback(scene)
+                self._record_event("auto_rollback", to_version=entry.version,
+                                   **action)
+            except ManifestError as e:
+                # Raced with an operator pointer move: degrade to a plain
+                # trip record — the version stays shed either way.
+                self._record_event("tripped", note=f"rollback lost: {e}",
+                                   **action)
+        elif kind == "tripped":
+            self._record_event("tripped", **action)
+        elif kind == "canary_rollback":
+            self._record_event("canary_rollback", **action)
+        elif kind == "canary_promote":
+            try:
+                self.manifest.promote(scene, version)
+                self._record_event("canary_promoted", **action)
+            except ManifestError as e:
+                self._record_event("canary_rollback",
+                                   note=f"finalize lost: {e}", **action)
+                kind = "canary_rollback"
+        if self._health_policy.evict_on_trip and kind in (
+                "auto_rollback", "tripped", "canary_rollback"):
+            self.cache.evict((scene, version))
+
+    def _record_event(self, kind: str, **fields) -> None:
+        with self._health_lock:
+            self.health_events.append({
+                "t": self._clock(), "event": kind, **fields,
+            })
+
+    def _resolve_serving(self, scene: str) -> SceneEntry:
+        """Breaker- and canary-aware resolution: the manifest's active
+        entry, unless a canary claims this dispatch; a resolved key whose
+        breaker is OPEN sheds typed instead of serving known-bad
+        weights."""
+        entry = self.manifest.resolve(scene)
+        with self._health_lock:
+            canary = self._canaries.get(scene)
+            canary_version = None
+            if canary is not None:
+                canary["count"] += 1
+                n, f = canary["count"], canary["fraction"]
+                if int(n * f) > int((n - 1) * f):
+                    canary_version = canary["version"]
+            key = (scene, canary_version) if canary_version is not None \
+                else entry.key
+            reason = self._tripped.get(key)
+        if reason is not None:
+            raise SceneUnhealthyError(
+                f"scene {scene!r} v{key[1]} breaker is open ({reason}); "
+                "release_scene() after the fault is fixed"
+            )
+        if canary_version is not None:
+            return self.manifest.entry(scene, canary_version)
+        return entry
 
     def compile_cache_size(self) -> int:
         """Total compiled programs across every bucket fn — the cache-miss
@@ -427,6 +922,11 @@ def make_registry_sharded_serve_fn(
     The batch tree is the coords-level sharded contract (``key``,
     ``coords_all``, ``pixels``, ``f``) — expert CNNs run upstream on the
     expert-parallel mesh; what hot-swaps here is the scene's camera.
+
+    With a health policy on the registry, this path rides the SAME
+    breaker/canary resolution and probe layer as ``infer_fn()`` (review
+    finding: a public serve entry that bypassed the breaker would keep
+    serving a tripped version's garbage on the sharded fleet).
     """
     from esac_tpu.parallel.esac_sharded import (
         make_esac_infer_sharded_frames_dynamic,
@@ -445,9 +945,21 @@ def make_registry_sharded_serve_fn(
                 "parallel.make_esac_infer_routed_frames_sharded for "
                 "image-level routed sharded serving"
             )
-        entry = registry.manifest.resolve(scene)
-        params = registry.cache.get(entry)
-        return infer(batch, params["c"])
+        if registry._health_policy is None:
+            entry = registry.manifest.resolve(scene)
+            params = registry.cache.get(entry)
+            return infer(batch, params["c"])
+        registry._drain_probes()
+        entry = registry._resolve_serving(scene)
+        try:
+            params = registry.cache.get(entry)
+            out = infer(batch, params["c"])
+        except Exception:
+            registry._record_failure_sample(
+                entry.key, registry._batch_frames(batch))
+            raise
+        registry._enqueue_probe(entry.key, out)
+        return out
 
     serve._cache_size = infer._cache_size
     return serve

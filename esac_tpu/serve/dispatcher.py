@@ -724,8 +724,14 @@ class MicroBatchDispatcher:
                 attempt += 1
                 with self._work:
                     stale = gen is not None and gen != self._gen
+                    # Deterministic typed faults (retryable=False — e.g. a
+                    # registry checksum mismatch or breaker shed, whose
+                    # loader-level transients were already retried) fail
+                    # the batch immediately: re-running the dispatch can
+                    # only re-pay the fault and delay the typed outcome.
                     retrying = (not stale and self._slo is not None
                                 and attempt <= self._slo.retry_max
+                                and getattr(e, "retryable", True)
                                 and not self._closed)
                     if retrying:
                         # Stay registered through the backoff (fresh age

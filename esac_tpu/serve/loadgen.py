@@ -85,6 +85,10 @@ def run_open_loop(
         raise ValueError("empty arrival schedule")
     admitted = []          # (index, request)
     outcomes = [None] * n  # per-request outcome string
+    # Typed-error class name per request (None for clean serves): the
+    # chaos drill's per-fault accounting keys on WHICH typed fault ended
+    # a request, not just its outcome class.
+    err_types = [None] * n
     # Pacing runs on the harness clock; every latency/deadline quantity
     # below comes from the REQUESTS' own timestamps (the dispatcher's
     # clock domain) — mixing the two would corrupt wait budgets the
@@ -103,14 +107,16 @@ def run_open_loop(
         try:
             req = disp.submit(frame, scene=scene, route_k=route_k,
                               deadline_ms=deadline_ms)
-        except ShedError:
+        except ShedError as e:
             outcomes[i] = "shed"
+            err_types[i] = type(e).__name__
             continue
         except DeadlineExceededError:
             # A no-SLO dispatcher's bounded space wait expires instead of
             # shedding; the request's fate is recorded, never a harness
             # crash that loses the whole point's outcomes.
             outcomes[i] = "expired"
+            err_types[i] = "DeadlineExceededError"
             continue
         admitted.append((i, req, time.perf_counter()))
     t_last_arrival = time.perf_counter()
@@ -128,6 +134,8 @@ def run_open_loop(
             outcomes[i] = "lost"  # should be impossible; surfaced, not hidden
             continue
         outcomes[i] = req.outcome
+        if req.error is not None:
+            err_types[i] = type(req.error).__name__
         if req.outcome in ("served", "degraded"):
             # Latency in the dispatcher's clock domain; the completion
             # instant anchored on the ACTUAL submit time (a generator
@@ -159,4 +167,5 @@ def run_open_loop(
         "p99_ms": round(q(0.99) * 1e3, 2),
         "span_s": round(span, 3),
         "per_request_outcomes": outcomes,
+        "per_request_error_types": err_types,
     }

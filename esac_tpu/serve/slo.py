@@ -38,7 +38,16 @@ import threading
 
 
 class ServeError(RuntimeError):
-    """Base class of every typed serving failure."""
+    """Base class of every typed serving failure.
+
+    ``retryable`` tells the dispatcher's transient-failure retry loop
+    whether re-running the dispatch could possibly change the outcome:
+    subclasses representing *deterministic* faults (a checksum mismatch,
+    a breaker-shed scene — see esac_tpu.registry.health) set it False
+    and the dispatcher fails the batch immediately instead of re-paying
+    the fault ``retry_max`` times."""
+
+    retryable = True
 
 
 class ShedError(ServeError):
@@ -165,18 +174,32 @@ class SLOPolicy:
 
 
 class FaultInjector:
-    """Stall/failure shim wrapping a dispatcher ``infer_fn``.
+    """Stall/failure shim wrapping a dispatcher ``infer_fn`` — and, since
+    ISSUE 9, the registry's checkpoint-read path.
 
-    Arm with :meth:`stall_once` (the Nth call blocks on an Event until the
-    test releases it — byte-for-byte the observed relay stall from the
-    worker thread's point of view) or :meth:`fail_times` (the next calls
-    raise).  Unarmed calls pass straight through.  All mutable state is
-    guarded by the instance lock (graft-lint R10 applies to this module);
-    the stall wait itself happens OUTSIDE the lock so stats stay readable
-    while a dispatch is wedged.
+    Dispatch path: arm with :meth:`stall_once` (the Nth call blocks on an
+    Event until the test releases it — byte-for-byte the observed relay
+    stall from the worker thread's point of view) or :meth:`fail_times`
+    (the next calls raise).  Unarmed calls pass straight through.
+
+    Registry path: :meth:`checkpoint_reader` wraps a checkpoint-reading
+    fn (``load_checkpoint``-shaped: path -> (params, config)), making
+    every way a scene load can go bad drillable — :meth:`fail_loads`
+    (transient IO fault: the loader's retry/backoff is what's under
+    test), :meth:`corrupt_loads` (the read returns bit-flipped content,
+    so manifest checksum verification must catch it), and
+    :meth:`stall_loads` (a read that never returns — the relay-stall
+    mode on the cold-load path; the weight cache's per-key load futures
+    keep other scenes servable while the watchdog handles the wedge).
+    Each arming takes an optional ``match`` predicate over the checkpoint
+    path so a multi-scene drill can fault exactly one scene.
+
+    All mutable state is guarded by the instance lock (graft-lint R10
+    applies to this module); stall waits happen OUTSIDE the lock so
+    stats stay readable while a dispatch or load is wedged.
     """
 
-    def __init__(self, infer_fn):
+    def __init__(self, infer_fn=None):
         self._infer = infer_fn
         self._cache_size = getattr(infer_fn, "_cache_size", None)
         self._lock = threading.Lock()
@@ -187,6 +210,19 @@ class FaultInjector:
         self._calls = 0
         self._stalls = 0
         self._failures = 0
+        # Registry-path (checkpoint read) arming + counters.
+        self._load_fail_exc: Exception | None = None
+        self._load_fail_left = 0
+        self._load_fail_match = None
+        self._load_corrupt_left = 0
+        self._load_corrupt_match = None
+        self._load_stall_release: threading.Event | None = None
+        self._load_stall_after = 0
+        self._load_stall_match = None
+        self._load_calls = 0
+        self._load_failures = 0
+        self._load_corruptions = 0
+        self._load_stalls = 0
 
     def stall_once(self, release: threading.Event, after: int = 0) -> None:
         """Arm ONE stall: the ``after``-th call from now blocks on
@@ -201,12 +237,88 @@ class FaultInjector:
             self._fail_exc = exc
             self._fail_left = times
 
+    # ---- registry-path (checkpoint read) arming ----
+
+    def fail_loads(self, exc: Exception, times: int = 1, match=None) -> None:
+        """Arm ``times`` checkpoint reads (matching ``match``, a predicate
+        over the path string; None = every read) to raise ``exc`` —
+        the transient-IO-fault drill for the loader's retry/backoff."""
+        with self._lock:
+            self._load_fail_exc = exc
+            self._load_fail_left = times
+            self._load_fail_match = match
+
+    def corrupt_loads(self, times: int = 1, match=None) -> None:
+        """Arm ``times`` matching reads to return CORRUPTED content (the
+        first float leaf bit-flipped): the read itself succeeds, so only
+        manifest checksum verification stands between the corruption and
+        served garbage — exactly the gap under drill."""
+        with self._lock:
+            self._load_corrupt_left = times
+            self._load_corrupt_match = match
+
+    def stall_loads(self, release: threading.Event, after: int = 0,
+                    match=None) -> None:
+        """Arm ONE matching read to block on ``release`` (``after`` later
+        matching reads first) — the relay stall on the cold-load path."""
+        with self._lock:
+            self._load_stall_release = release
+            self._load_stall_after = after
+            self._load_stall_match = match
+
+    def checkpoint_reader(self, read_fn):
+        """Wrap a ``path -> (params, config)`` checkpoint reader with the
+        armed registry faults.  Hand the result to
+        ``load_scene_params(..., read_checkpoint=...)`` (or a
+        functools.partial of it as a cache loader)."""
+
+        def read(path):
+            release = None
+            corrupt = False
+            p = str(path)
+            with self._lock:
+                self._load_calls += 1
+                if self._load_stall_release is not None and (
+                        self._load_stall_match is None
+                        or self._load_stall_match(p)):
+                    if self._load_stall_after <= 0:
+                        release = self._load_stall_release
+                        self._load_stall_release = None
+                        self._load_stalls += 1
+                    else:
+                        self._load_stall_after -= 1
+                if release is None and self._load_fail_left > 0 and (
+                        self._load_fail_match is None
+                        or self._load_fail_match(p)):
+                    self._load_fail_left -= 1
+                    self._load_failures += 1
+                    exc = self._load_fail_exc
+                    raise exc
+                if release is None and self._load_corrupt_left > 0 and (
+                        self._load_corrupt_match is None
+                        or self._load_corrupt_match(p)):
+                    self._load_corrupt_left -= 1
+                    self._load_corruptions += 1
+                    corrupt = True
+            if release is not None:
+                release.wait()  # the cold-load wedge
+            params, config = read_fn(path)
+            if corrupt:
+                params = _bitflip_first_leaf(params)
+            return params, config
+
+        return read
+
     def stats(self) -> dict:
         with self._lock:
             return {
                 "calls": self._calls,
                 "stalls": self._stalls,
                 "failures": self._failures,
+                "load_calls": self._load_calls,
+                "load_failures": self._load_failures,
+                "load_corruptions": self._load_corruptions,
+                "load_stalls": self._load_stalls,
             }
 
     def __call__(self, tree, *rest):
@@ -228,3 +340,32 @@ class FaultInjector:
         if release is not None:
             release.wait()  # the wedge: held until the test releases it
         return self._infer(tree, *rest)
+
+
+def _bitflip_first_leaf(params):
+    """Copy ``params`` with its first array leaf perturbed — simulated
+    read corruption (content changes, structure survives, so the damage
+    is invisible to everything EXCEPT a content checksum)."""
+    import numpy as np
+
+    done = {"flag": False}
+
+    def walk(node):
+        if isinstance(node, dict):
+            return {k: walk(node[k]) for k in node}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v) for v in node)
+        if not done["flag"] and hasattr(node, "shape"):
+            arr = np.array(node, copy=True)
+            if arr.size:
+                flat = arr.reshape(-1)
+                if np.issubdtype(arr.dtype, np.floating):
+                    flat[0] = flat[0] + 1.0
+                else:
+                    flat[0] = ~flat[0]
+                done["flag"] = True
+                return arr
+        return node
+
+    return walk(params)
+

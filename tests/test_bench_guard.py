@@ -615,3 +615,176 @@ def test_scoring_artifact_schema_committed():
         # On CPU fused_select runs the chunked errmap-math sibling: the
         # winner must be bit-identical at EVERY sweep point.
         assert sc["winner_bit_identical_all"] is True
+
+
+# ---------------- chaos driver contract (ISSUE 9) ----------------
+
+def _canned_chaos():
+    """Minimal-but-complete chaos payload: the schema the driver and the
+    committed .chaos_drill.json artifact rely on."""
+    def scene(outcomes, errs=None, goodput=1.0):
+        return {
+            "offered": sum(outcomes.values()),
+            "outcomes": outcomes,
+            "error_types": errs or {},
+            "sums_to_offered": True,
+            "goodput": goodput,
+        }
+
+    return {
+        "scenes": {"n": 4, "hw": [24, 24], "num_experts": 2, "n_hyps": 4,
+                   "frame_bucket": 2},
+        "closed_loop_dispatch_ms": 2.0,
+        "offered_rps": 500.0, "offered_x_capacity": 0.5,
+        "deadline_ms": 1500.0, "offered_per_phase": 100,
+        "baseline": {"s_ok": scene({"served": 25})},
+        "fault_window": {
+            "per_scene": {
+                "s_ok": scene({"served": 25}),
+                "s_corrupt": scene({"failed": 2, "shed": 23},
+                                   {"ChecksumMismatchError": 2,
+                                    "LaneQuarantinedError": 23}, 0.0),
+                "s_ioflaky": scene({"served": 25}),
+                "s_nan": scene({"served": 25}),
+            },
+            "accounting_exact": True,
+            "dispatcher_totals": {"offered": 100, "served": 75, "shed": 23,
+                                  "expired": 0, "degraded": 0, "failed": 2,
+                                  "pending": 0},
+            "healthy_goodput_retention": 1.0,
+        },
+        "faults": {
+            "corrupt_checkpoint": {
+                "scene": "s_corrupt", "injected_corrupt_reads": 3,
+                "typed_errors": {"ChecksumMismatchError": 2},
+                "quarantined_lanes": [["s_corrupt", None]],
+                "released_and_recovered": True, "recovery_latency_s": 0.05,
+            },
+            "transient_io": {
+                "scene": "s_ioflaky", "injected_failures": 2,
+                "goodput": 1.0, "retried_transparently": True,
+            },
+            "nan_weights": {
+                "scene": "s_nan", "auto_rolled_back": True,
+                "rollback_latency_s": 0.2, "active_version_after": 1,
+                "garbage_frames_before_trip": 4,
+                "post_rollback_bit_identical": True,
+            },
+        },
+        "canary": {"scene": "s_ok", "fraction": 0.5,
+                   "events": ["canary_start", "canary_promoted"],
+                   "finalized": True, "active_version_after": 2},
+        "compiled_programs": {"before_faults": 1, "after_drill": 1,
+                              "hot_path_recompiles": 0},
+        "health_events": [],
+        "note": "canned",
+    }
+
+
+def test_chaos_main_emits_one_json_line_and_artifact(tmp_path, monkeypatch, capsys):
+    """The driver contract: ONE parseable JSON line, headline = healthy
+    goodput retention, the rollback/recompile acceptance fields surfaced,
+    and the .chaos_drill.json artifact with platform + recorded_at."""
+    monkeypatch.setattr(bench, "_CHAOS_FILE", tmp_path / "chaos.json")
+    monkeypatch.setattr(
+        bench, "measure_on_device",
+        lambda *a, **k: {"chaos": _canned_chaos(), "platform": "tpu",
+                         "device_kind": "fake-tpu"},
+    )
+    bench._chaos_main([], [0.0, 0.0, 0.0])
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1, f"expected ONE JSON line, got {len(lines)}"
+    out = json.loads(lines[0])
+    assert out["metric"] == "chaos_healthy_scene_goodput_retention"
+    assert out["value"] == 1.0
+    assert out["unit"] == "goodput_ratio"
+    assert "vs_baseline" in out
+    assert out["accounting_exact"] is True
+    assert out["post_rollback_bit_identical"] is True
+    assert out["hot_path_recompiles"] == 0
+    assert out["device_kind"] == "fake-tpu"
+    assert "contention" in out
+    artifact = json.loads((tmp_path / "chaos.json").read_text())
+    assert artifact["platform"] == "tpu"
+    assert "recorded_at" in artifact
+    assert artifact["chaos"]["faults"]["nan_weights"]["auto_rolled_back"]
+
+
+def test_chaos_cpu_fallback_carries_provenance(tmp_path, monkeypatch, capsys):
+    """Relay wedged -> the drill measures on CPU and SAYS so: note field
+    on the JSON line, platform "cpu" in the artifact."""
+    monkeypatch.setattr(bench, "_CHAOS_FILE", tmp_path / "chaos.json")
+    monkeypatch.setattr(bench, "measure_on_device", lambda *a, **k: None)
+    monkeypatch.setattr(bench, "_measure_chaos", lambda *a, **k: _canned_chaos())
+    bench._chaos_main([], [0.0, 0.0, 0.0])
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1
+    out = json.loads(lines[0])
+    assert "CPU" in out["note"] or "cpu" in out["note"]
+    artifact = json.loads((tmp_path / "chaos.json").read_text())
+    assert artifact["platform"] == "cpu"
+    assert artifact["note"] == out["note"]
+
+
+def test_chaos_artifact_schema_committed():
+    """The committed .chaos_drill.json satisfies the acceptance schema:
+    per-fault-class outcome accounting sums exactly to offered, healthy
+    goodput retained >= 0.99 under faults, the auto-rollback served
+    bit-identically with zero hot-path recompiles, and the transient-IO
+    fault never surfaced as a failed request."""
+    import pathlib
+
+    path = pathlib.Path(bench.__file__).parent / ".chaos_drill.json"
+    if not path.exists():
+        import pytest
+
+        pytest.skip("no committed chaos artifact yet")
+    artifact = json.loads(path.read_text())
+    for key in ("metric", "value", "unit", "platform", "recorded_at",
+                "chaos"):
+        assert key in artifact, key
+    chaos = artifact["chaos"]
+    for phase in ("baseline", ):
+        for rec in chaos[phase].values():
+            assert sum(rec["outcomes"].values()) == rec["offered"]
+    fw = chaos["fault_window"]
+    assert set(fw["per_scene"]) == {"s_ok", "s_corrupt", "s_ioflaky",
+                                    "s_nan"}
+    for rec in fw["per_scene"].values():
+        assert sum(rec["outcomes"].values()) == rec["offered"], rec
+        assert rec["sums_to_offered"] is True
+    t = fw["dispatcher_totals"]
+    assert (t["served"] + t["shed"] + t["expired"] + t["degraded"]
+            + t["failed"] + t["pending"] == t["offered"])
+    assert fw["accounting_exact"] is True
+    assert fw["healthy_goodput_retention"] >= 0.99
+    faults = chaos["faults"]
+    assert faults["corrupt_checkpoint"]["typed_errors"].get(
+        "ChecksumMismatchError", 0) >= 1
+    assert faults["corrupt_checkpoint"]["released_and_recovered"] is True
+    assert faults["transient_io"]["retried_transparently"] is True
+    assert faults["nan_weights"]["auto_rolled_back"] is True
+    assert faults["nan_weights"]["post_rollback_bit_identical"] is True
+    assert chaos["compiled_programs"]["hot_path_recompiles"] == 0
+    assert chaos["canary"]["finalized"] in (True, False)
+
+
+def test_all_mode_mains_share_the_wedge_safe_scaffold(monkeypatch):
+    """TODO item 6 (ISSUE 9 satellite): every bench mode routes through
+    the ONE _driver_main scaffold — a wedge-safety or provenance fix
+    cannot silently miss a mode anymore."""
+    calls = []
+
+    def spy(stopped, load_before, **kw):
+        calls.append((kw["key"], kw["what"]))
+        assert callable(kw["measure_cpu"]) and callable(kw["headline"])
+        assert str(kw["artifact_path"]).endswith(".json")
+
+    monkeypatch.setattr(bench, "_driver_main", spy)
+    for main in (bench._serve_main, bench._registry_main,
+                 bench._routed_main, bench._loadtest_main,
+                 bench._scoring_main, bench._chaos_main):
+        main([], [0.0, 0.0, 0.0])
+    assert [c[0] for c in calls] == [
+        "serve", "registry", "routed", "loadtest", "scoring", "chaos",
+    ]

@@ -146,9 +146,15 @@ def _valid_doc(scenes):
 @pytest.mark.parametrize("mutate,err", [
     (lambda d: d.update(format_version=99), "format_version"),
     (lambda d: d.update(extra_field=1), "unknown field"),
+    (lambda d: d.pop("scenes"), "missing scenes"),
     (lambda d: d["scenes"]["a"].pop("versions"), "versions"),
     (lambda d: d["scenes"]["a"].update(active=7), "active"),
-    (lambda d: d["scenes"]["a"].update(active="one"), "not an integer"),
+    (lambda d: d["scenes"]["a"].update(active="one"), "not an exact integer"),
+    # ISSUE 9 silent-acceptance gap: bool/float pointers used to hydrate
+    # by int() truncation — `true` became v1, 1.7 became v1, without
+    # complaint.  Exact integers only.
+    (lambda d: d["scenes"]["a"].update(active=True), "not an exact integer"),
+    (lambda d: d["scenes"]["a"].update(previous=1.7), "not an exact integer"),
     (lambda d: d["scenes"]["a"].update(previous=7), "previous"),
     (lambda d: d["scenes"]["a"].update(
         versions=list(d["scenes"]["a"]["versions"])), "must be an object"),
@@ -164,6 +170,20 @@ def _valid_doc(scenes):
      "stride"),
     (lambda d: d["scenes"]["a"]["versions"]["1"].update(gating_ckpt=None),
      "gated"),
+    # Schema v2 (ISSUE 9): forward-compat rejection + checksum shapes.
+    (lambda d: d["scenes"]["a"]["versions"]["1"].update(schema_version=99),
+     "newer than this reader"),
+    (lambda d: d["scenes"]["a"]["versions"]["1"].update(schema_version=1.5),
+     "schema_version"),
+    (lambda d: d["scenes"]["a"]["versions"]["1"].update(
+        checksums=[["expert", "zz"]]), "not 64-hex"),
+    (lambda d: d["scenes"]["a"]["versions"]["1"].update(
+        checksums=[["warp", "0" * 64]]), "unknown checksum role"),
+    (lambda d: d["scenes"]["a"]["versions"]["1"].update(
+        checksums=[["expert", "0" * 64], ["expert", "1" * 64]]),
+     "duplicate checksum role"),
+    (lambda d: d["scenes"]["a"]["versions"]["1"].update(checksums=7),
+     "checksums"),
 ])
 def test_manifest_rejects_malformed(scenes, mutate, err):
     doc = _valid_doc(scenes)

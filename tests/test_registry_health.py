@@ -1,0 +1,935 @@
+"""Fleet fault-tolerance tests (ISSUE 9; DESIGN.md §13).
+
+The load-bearing claims:
+
+- checkpoint integrity: manifest entries carry content checksums that
+  round-trip, and a corrupt READ becomes a typed
+  ``ChecksumMismatchError`` at load time — never served garbage;
+- transient IO faults are retried with capped backoff inside the loader
+  (invisible to the dispatch), persistent ones surface as a typed
+  ``SceneLoadError``; both are non-retryable at the dispatcher layer;
+- the per-(scene, version) health breaker trips on non-finite winners
+  (NaN weights) and AUTO-ROLLS-BACK to the last-known-good version —
+  results bit-identical to loading that version directly, with zero
+  hot-path recompiles (the jit cache-miss counter is pinned);
+- canary promotion routes a bounded traffic fraction to the new
+  version and auto-finalizes / auto-rolls-back on its health vs the
+  incumbent; ``release_scene`` is the operator override;
+- one scene's stalled cold load cannot block another scene's warm hit
+  (the weight cache's per-key load futures);
+- concurrent promote/rollback racing live dispatches: every in-flight
+  request drains on the version it resolved, accounting stays exact
+  (the slow ``test_heavy_*`` stress leg).
+
+Breaker/canary LOGIC tests run on stubbed programs (no jit — fast,
+deterministic); the rollback bit-identity and the stress leg run the
+real 16x16 bucket programs.
+"""
+
+import dataclasses
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esac_tpu.models import ExpertNet, GatingNet
+from esac_tpu.ransac import RansacConfig
+from esac_tpu.registry import (
+    ChecksumMismatchError,
+    DeviceWeightCache,
+    HealthPolicy,
+    ManifestError,
+    SceneEntry,
+    SceneManifest,
+    ScenePreset,
+    SceneRegistry,
+    SceneUnhealthyError,
+    SceneLoadError,
+    compute_entry_checksums,
+    load_scene_params,
+    params_checksum,
+    unhealthy_frames,
+)
+from esac_tpu.serve import FaultInjector, MicroBatchDispatcher, SLOPolicy
+from esac_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+H = W = 16
+M = 2
+PRESET = ScenePreset(
+    height=H, width=W, num_experts=M,
+    stem_channels=(2, 2, 2), head_channels=2, head_depth=1,
+    gating_channels=(2,), compute_dtype="float32", gated=True,
+)
+CFG = RansacConfig(n_hyps=8, refine_iters=2, polish_iters=1,
+                   frame_buckets=(1,))
+POSE_KEYS = ("rvec", "tvec", "scores", "expert")
+
+
+def _write_scene(root, name, version, seed, nan=False):
+    expert = ExpertNet(
+        scene_center=(0.0, 0.0, 0.0), stem_channels=PRESET.stem_channels,
+        head_channels=PRESET.head_channels, head_depth=PRESET.head_depth,
+        compute_dtype=jnp.float32,
+    )
+    img = jnp.zeros((1, H, W, 3))
+    e_params = jax.vmap(lambda k: expert.init(k, img))(
+        jax.random.split(jax.random.key(seed), M)
+    )
+    if nan:
+        # The NaN-weight fault: a structurally valid checkpoint whose
+        # content poisons every pose — checksums PASS (the content is
+        # exactly what was written); only the health breaker catches it.
+        e_params = jax.tree.map(lambda x: np.full_like(x, np.nan), e_params)
+    centers = (np.asarray([[0.0, 0.0, 2.0]], np.float32)
+               + np.arange(M, dtype=np.float32)[:, None] * 0.1 + seed * 0.01)
+    d = root / f"{name}_v{version}"
+    save_checkpoint(d / "expert", e_params, {
+        "stem_channels": list(PRESET.stem_channels),
+        "head_channels": PRESET.head_channels,
+        "head_depth": PRESET.head_depth,
+        "scene_centers": centers.tolist(),
+        "f": 20.0, "c": [W / 2.0, H / 2.0],
+    })
+    gating = GatingNet(num_experts=M, channels=PRESET.gating_channels,
+                       compute_dtype=jnp.float32)
+    save_checkpoint(d / "gating", gating.init(jax.random.key(seed + 100), img),
+                    {"num_experts": M})
+    return SceneEntry(
+        scene_id=name, version=version,
+        expert_ckpt=str(d / "expert"), gating_ckpt=str(d / "gating"),
+        preset=PRESET, ransac=CFG,
+    )
+
+
+@pytest.fixture(scope="module")
+def scenes(tmp_path_factory):
+    """scene 'a': v1 good, v2 good (different weights), v3 NaN weights."""
+    root = tmp_path_factory.mktemp("health_scenes")
+    return {
+        1: _write_scene(root, "a", 1, seed=0),
+        2: _write_scene(root, "a", 2, seed=5),
+        3: _write_scene(root, "a", 3, seed=9, nan=True),
+    }
+
+
+def _frame(i):
+    img = jax.random.uniform(jax.random.fold_in(jax.random.key(42), i),
+                             (H, W, 3))
+    return {"key": jax.random.fold_in(jax.random.key(7), i),
+            "image": np.asarray(img)}
+
+
+def _bitwise_equal(a, b, keys=POSE_KEYS):
+    return all(np.array_equal(np.asarray(a[k]), np.asarray(b[k]))
+               for k in keys)
+
+
+# ---------------- policy + sample extraction ----------------
+
+def test_health_policy_validation():
+    with pytest.raises(ValueError):
+        HealthPolicy(window=0)
+    with pytest.raises(ValueError):
+        HealthPolicy(trip_bad_frac=0.0)
+    with pytest.raises(ValueError):
+        HealthPolicy(trip_bad_frac=1.5)
+    with pytest.raises(ValueError):
+        HealthPolicy(canary_min_samples=0)
+    with pytest.raises(ValueError):
+        HealthPolicy(canary_bad_slack=-0.1)
+
+
+def test_unhealthy_frames_counts_any_nonfinite_leaf():
+    rvec = np.zeros((4, 3))
+    rvec[1, 2] = np.nan
+    frac = np.ones(4)
+    frac[3] = np.inf
+    bad, total = unhealthy_frames({"rvec": rvec, "inlier_frac": frac})
+    assert (bad, total) == (2, 4)
+    assert unhealthy_frames({"rvec": np.zeros((2, 3))}) == (0, 2)
+    assert unhealthy_frames({}) == (0, 0)
+
+
+# ---------------- checksums + typed load faults ----------------
+
+def test_params_checksum_is_content_sensitive():
+    params = {"a": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    h1 = params_checksum(params, {"f": 1.0})
+    assert h1 == params_checksum(
+        {"a": params["a"].copy()}, {"f": 1.0})  # deterministic
+    bumped = {"a": params["a"].copy()}
+    bumped["a"][0, 0] += 1.0
+    assert params_checksum(bumped, {"f": 1.0}) != h1      # content
+    assert params_checksum(params, {"f": 2.0}) != h1      # config sidecar
+    assert params_checksum(
+        {"a": params["a"].reshape(3, 2)}, {"f": 1.0}) != h1  # shape
+
+
+def test_compute_entry_checksums_round_trip_and_verified_load(scenes):
+    entry = compute_entry_checksums(scenes[1])
+    assert set(entry.checksum_map) == {"expert", "gating"}
+    m = SceneManifest()
+    m.add(entry)
+    rt = SceneManifest.from_json(m.to_json())
+    assert rt.resolve("a").checksums == entry.checksums
+    assert rt.resolve("a").schema_version == 2
+    # Verified load succeeds and matches the unverified tree bitwise.
+    verified = load_scene_params(rt.resolve("a"))
+    plain = load_scene_params(scenes[1])
+    assert all(
+        np.array_equal(a, b) for a, b in
+        zip(jax.tree.leaves(verified), jax.tree.leaves(plain))
+    )
+
+
+def test_corrupt_read_raises_checksum_mismatch(scenes):
+    entry = compute_entry_checksums(scenes[1])
+    inj = FaultInjector()
+    read = inj.checkpoint_reader(load_checkpoint)
+    inj.corrupt_loads(times=1)
+    with pytest.raises(ChecksumMismatchError, match="corrupt or swapped"):
+        load_scene_params(entry, read_checkpoint=read)
+    assert inj.stats()["load_corruptions"] == 1
+    # Unarmed, the same reader loads clean — the fault was the content.
+    load_scene_params(entry, read_checkpoint=read)
+    # Without checksums the same corruption is INVISIBLE (the gap the
+    # manifest checksums exist to close).
+    inj.corrupt_loads(times=1)
+    load_scene_params(scenes[1], read_checkpoint=read)
+
+
+def test_transient_io_fault_is_retried_transparently(scenes):
+    inj = FaultInjector()
+    read = inj.checkpoint_reader(load_checkpoint)
+    inj.fail_loads(OSError("injected EIO"), times=2)
+    tree = load_scene_params(scenes[1], read_checkpoint=read,
+                             retries=2, backoff_s=0.001)
+    assert inj.stats()["load_failures"] == 2
+    assert "expert" in tree  # served despite two transient faults
+
+
+def test_persistent_io_fault_raises_typed_scene_load_error(scenes):
+    inj = FaultInjector()
+    read = inj.checkpoint_reader(load_checkpoint)
+    inj.fail_loads(OSError("injected EIO"), times=10)
+    with pytest.raises(SceneLoadError, match="failed to load after"):
+        load_scene_params(scenes[1], read_checkpoint=read,
+                          retries=1, backoff_s=0.001)
+    assert not SceneLoadError("x").retryable
+    assert not ChecksumMismatchError("x").retryable
+    assert not SceneUnhealthyError("x").retryable
+    # The taxonomy: load faults are BOTH manifest and serve errors.
+    assert issubclass(SceneLoadError, ManifestError)
+    from esac_tpu.serve import ServeError
+
+    assert issubclass(SceneLoadError, ServeError)
+
+
+def test_non_retryable_dispatch_fault_skips_the_retry_loop():
+    """A deterministic typed fault (retryable=False) must fail the batch
+    on the FIRST attempt — the loader already retried transients, so the
+    dispatcher's retry loop would only re-pay the fault."""
+    calls = []
+
+    def corrupt(tree, scene=None, route_k=None):
+        calls.append(1)
+        raise ChecksumMismatchError("corrupt weights")
+
+    cfg = dataclasses.replace(CFG, serve_max_wait_ms=0.0)
+    disp = MicroBatchDispatcher(corrupt, cfg,
+                                slo=SLOPolicy(retry_max=3,
+                                              retry_backoff_ms=1.0))
+    with pytest.raises(ChecksumMismatchError):
+        disp.infer_one({"x": np.zeros(2, np.float32)}, scene="s",
+                       timeout=10.0)
+    disp.close()
+    assert len(calls) == 1, "non-retryable fault was retried"
+    t = disp.slo_totals()
+    assert t["failed"] == 1 and t["served"] == 0
+
+
+def test_stalled_load_does_not_block_other_scenes_or_double_load():
+    """The weight cache's per-key load futures: one scene's wedged cold
+    load leaves every other scene servable, and two concurrent getters
+    of the SAME scene still trigger exactly one load."""
+    release = threading.Event()
+    loads = []
+    lock = threading.Lock()
+
+    @dataclasses.dataclass(frozen=True)
+    class E:
+        scene_id: str
+
+        @property
+        def key(self):
+            return (self.scene_id, 1)
+
+    def loader(entry):
+        with lock:
+            loads.append(entry.scene_id)
+        if entry.scene_id == "slow":
+            release.wait()
+        return {"w": np.zeros(4, np.float32)}
+
+    cache = DeviceWeightCache(loader)
+    got = {}
+
+    def getter(name, sid):
+        got[name] = cache.get(E(sid))
+
+    t1 = threading.Thread(target=getter, args=("slow1", "slow"))
+    t2 = threading.Thread(target=getter, args=("slow2", "slow"))
+    t1.start()
+    deadline = time.time() + 5.0
+    while not loads and time.time() < deadline:
+        time.sleep(0.01)  # the slow load is IN FLIGHT (holding no lock)
+    t2.start()
+    t0 = time.perf_counter()
+    fast = cache.get(E("fast"))  # must not block behind the wedged load
+    assert time.perf_counter() - t0 < 2.0
+    assert fast is not None
+    assert cache.stats()["loads_in_flight"] == 1
+    release.set()
+    t1.join(10.0)
+    t2.join(10.0)
+    assert not t1.is_alive() and not t2.is_alive()
+    assert got["slow1"] is got["slow2"]  # one load, one tree
+    assert loads.count("slow") == 1 and loads.count("fast") == 1
+
+
+def test_failed_load_caches_nothing_and_next_get_retries():
+    attempts = []
+
+    @dataclasses.dataclass(frozen=True)
+    class E:
+        scene_id: str = "s"
+
+        @property
+        def key(self):
+            return ("s", 1)
+
+    def loader(entry):
+        attempts.append(1)
+        if len(attempts) == 1:
+            raise SceneLoadError("injected")
+        return {"w": np.zeros(4, np.float32)}
+
+    cache = DeviceWeightCache(loader)
+    with pytest.raises(SceneLoadError):
+        cache.get(E())
+    assert cache.stats()["load_failures"] == 1
+    assert len(cache) == 0
+    cache.get(E())  # recovered: the failure poisoned nothing
+    assert len(cache) == 1 and len(attempts) == 2
+
+
+# ---------------- breaker + canary logic (stubbed programs) ----------
+
+def _stub_registry(versions_output, n_versions=2, policy=None):
+    """A SceneRegistry over scene 's' with ``n_versions`` fake entries,
+    a stub loader, and ``_fn_for`` stubbed to return per-version host
+    trees from ``versions_output`` — breaker/canary logic isolated from
+    jit entirely."""
+    preset = ScenePreset(height=16, width=16, num_experts=2, gated=False)
+    m = SceneManifest()
+    for v in range(1, n_versions + 1):
+        m.add(SceneEntry(scene_id="s", version=v, expert_ckpt=f"/ck{v}",
+                         preset=preset), activate=False)
+    reg = SceneRegistry(
+        m, loader=lambda e: {"w": np.zeros(4, np.float32)},
+        health=policy or HealthPolicy(window=8, min_samples=4,
+                                      trip_bad_frac=0.5,
+                                      canary_min_samples=8),
+    )
+    reg._fn_for = lambda entry, route_k=None, n_hyps=None: (
+        lambda params, batch: versions_output[entry.version]
+    )
+    return reg, reg.infer_fn()
+
+
+def _out(n=2, bad=False):
+    v = np.nan if bad else 0.0
+    return {"rvec": np.full((n, 3), v), "tvec": np.zeros((n, 3)),
+            "inlier_frac": np.ones(n)}
+
+
+def test_breaker_trips_and_auto_rolls_back_to_last_known_good():
+    reg, serve = _stub_registry({1: _out(), 2: _out(bad=True)})
+    for _ in range(3):
+        serve({}, "s")
+    reg.manifest.promote("s", 2)
+    for _ in range(3):  # 6 NaN frames ride v2 before the trip settles
+        serve({}, "s")
+    serve({}, "s")  # drain happens here: trip + rollback, then serves v1
+    assert reg.manifest.active_version("s") == 1
+    h = reg.health()
+    assert h["scenes"]["s@v2"]["tripped"] is not None
+    assert h["scenes"]["s@v1"]["tripped"] is None
+    events = [e["event"] for e in h["events"]]
+    assert events == ["auto_rollback"]
+    # The tripped version's weights were evicted; v1's stayed.
+    assert ("s", 2) not in reg.cache
+    # Subsequent traffic serves v1 and stays healthy.
+    for _ in range(4):
+        serve({}, "s")
+    assert reg.manifest.active_version("s") == 1
+
+
+def test_breaker_without_rollback_target_sheds_typed_until_release():
+    outputs = {1: _out(bad=True)}
+    reg, serve = _stub_registry(outputs, n_versions=1)
+    tripped = False
+    for _ in range(6):
+        try:
+            serve({}, "s")
+        except SceneUnhealthyError:
+            tripped = True
+            break
+    assert tripped, "breaker never tripped on all-NaN winners"
+    with pytest.raises(SceneUnhealthyError, match="release_scene"):
+        serve({}, "s")
+    assert [e["event"] for e in reg.health()["events"]] == ["tripped"]
+    # Operator fixes the fault and releases: the scene serves again.
+    outputs[1] = _out()
+    reg.release_scene("s")
+    serve({}, "s")
+    assert reg.health()["scenes"]["s@v1"]["tripped"] is None
+
+
+def test_breaker_never_rolls_back_into_a_tripped_version():
+    outputs = {1: _out(bad=True), 2: _out(bad=True)}
+    reg, serve = _stub_registry(outputs)
+
+    def drive_until_shed(max_serves=8):
+        for _ in range(max_serves):
+            try:
+                serve({}, "s")
+            except SceneUnhealthyError:
+                return True
+        return False
+
+    assert drive_until_shed()  # v1 trips; no previous -> typed shed
+    reg.manifest.promote("s", 2)  # operator moves on to v2 (also bad)
+    # v2 trips too; previous (v1) is itself tripped -> NO rollback,
+    # typed shed instead of ping-ponging between two known-bad versions.
+    assert drive_until_shed()
+    with pytest.raises(SceneUnhealthyError):
+        serve({}, "s")
+    assert reg.manifest.active_version("s") == 2
+    kinds = [e["event"] for e in reg.health()["events"]]
+    assert kinds == ["tripped", "tripped"]
+
+
+def test_canary_routes_bounded_fraction_and_finalizes_on_healthy():
+    reg, serve = _stub_registry({1: _out(), 2: _out()})
+    frac = 0.25
+    reg.promote("s", 2, canary=frac)
+    assert reg.manifest.active_version("s") == 1  # pointer did NOT move
+    served_versions = []
+    real_resolve = reg._resolve_serving
+
+    def spy(scene):
+        e = real_resolve(scene)
+        served_versions.append(e.version)
+        return e
+
+    reg._resolve_serving = spy
+    for _ in range(16):
+        serve({}, "s")
+    serve({}, "s")  # settle the probes
+    # Exactly floor(n * frac) dispatches rode the canary while it lived.
+    n_canary = served_versions.count(2)
+    assert 0 < n_canary <= int(len(served_versions) * frac) + 1
+    # 16 canary-side frames >= canary_min_samples with bad_frac 0 ->
+    # auto-finalized: the manifest now serves v2.
+    assert reg.manifest.active_version("s") == 2
+    events = [e["event"] for e in reg.health()["events"]]
+    assert events[0] == "canary_start" and events[-1] == "canary_promoted"
+
+
+def test_canary_rolls_back_on_unhealthy_and_blocks_repromote():
+    reg, serve = _stub_registry({1: _out(), 2: _out(bad=True)})
+    reg.promote("s", 2, canary=0.5)
+    for _ in range(12):
+        serve({}, "s")
+    serve({}, "s")
+    # The canary tripped: route dropped, incumbent never left active.
+    assert reg.manifest.active_version("s") == 1
+    h = reg.health()
+    assert h["canaries"] == {}
+    assert h["scenes"]["s@v2"]["tripped"] is not None
+    assert "canary_rollback" in [e["event"] for e in h["events"]]
+    # A tripped version cannot be silently re-canaried.
+    with pytest.raises(ManifestError, match="release_scene"):
+        reg.promote("s", 2, canary=0.5)
+    reg.release_scene("s", 2)
+    reg.promote("s", 2, canary=0.5)  # after release: allowed again
+
+
+def test_canary_guards_and_plain_promote_passthrough():
+    reg, serve = _stub_registry({1: _out(), 2: _out()})
+    with pytest.raises(ValueError, match="fraction"):
+        reg.promote("s", 2, canary=1.5)
+    with pytest.raises(ManifestError, match="already active"):
+        reg.promote("s", 1, canary=0.5)
+    with pytest.raises(ManifestError, match="no entry"):
+        reg.promote("s", 9, canary=0.5)
+    reg.promote("s", 2, canary=0.5)
+    with pytest.raises(ManifestError, match="in flight"):
+        reg.promote("s", 2, canary=0.5)
+    reg.release_scene("s")  # cancels the canary
+    assert reg.health()["canaries"] == {}
+    # canary=None is the PR-3 manifest promote, byte-for-byte.
+    entry = reg.promote("s", 2)
+    assert entry.version == 2 and reg.manifest.active_version("s") == 2
+
+
+def test_health_disabled_serves_without_probes():
+    preset = ScenePreset(height=16, width=16, num_experts=2, gated=False)
+    m = SceneManifest()
+    m.add(SceneEntry(scene_id="s", version=1, expert_ckpt="/ck",
+                     preset=preset))
+    reg = SceneRegistry(m, loader=lambda e: {"w": np.zeros(2)}, health=None)
+    reg._fn_for = lambda entry, route_k=None, n_hyps=None: (
+        lambda params, batch: _out(bad=True)
+    )
+    serve = reg.infer_fn()
+    for _ in range(8):
+        serve({}, "s")  # no breaker, no probes, no trip
+    assert reg.health(drain=False)["scenes"] == {}
+
+
+# ---------------- real programs: rollback bit-identity ----------------
+
+def test_nan_version_auto_rollback_bit_identical_zero_recompiles(scenes):
+    """THE tentpole acceptance: promote a NaN-weight version under real
+    bucket programs; the breaker trips and auto-rolls back, subsequent
+    results are bit-identical to loading the previous version directly,
+    and the jit cache-miss counter never moves (a rollback is a pointer
+    swap inside one compiled family)."""
+    m = SceneManifest()
+    m.add(scenes[1])
+    m.add(scenes[3], activate=False)  # v3: NaN weights
+    reg = SceneRegistry(
+        m, health=HealthPolicy(window=8, min_samples=2, trip_bad_frac=0.5)
+    )
+    disp = reg.dispatcher(CFG, start_worker=False)
+    frames = [_frame(i) for i in range(3)]
+    want = [disp.infer_one(f, scene="a") for f in frames]
+    compiled = disp.cache_size()
+
+    reg.promote("a", 3)
+    garbage = 0
+    for i in range(6):
+        try:
+            out = disp.infer_one(frames[i % 3], scene="a")
+            if not np.isfinite(np.asarray(out["rvec"])).all():
+                garbage += 1
+        except SceneUnhealthyError:
+            pass
+        if m.active_version("a") == 1:
+            break
+    assert m.active_version("a") == 1, "breaker did not roll back"
+    events = [e["event"] for e in reg.health()["events"]]
+    assert "auto_rollback" in events
+    assert garbage >= 1  # the breaker needs samples; the window is bounded
+
+    # Post-rollback results are bit-identical to v1 served directly.
+    for f, w in zip(frames, want):
+        assert _bitwise_equal(disp.infer_one(f, scene="a"), w)
+    # A fresh v1-only registry agrees bitwise too (rollback == loading
+    # the previous version directly).
+    solo = SceneRegistry(SceneManifest())
+    solo.manifest.add(scenes[1])
+    sdisp = solo.dispatcher(CFG, start_worker=False)
+    for f, w in zip(frames, want):
+        assert _bitwise_equal(sdisp.infer_one(f, scene="a"), w)
+    assert disp.cache_size() == compiled, "rollback recompiled"
+
+
+# ---------------- heavy leg: promote/rollback vs live dispatches ------
+
+@pytest.mark.slow
+def test_heavy_concurrent_promote_rollback_racing_dispatches(scenes):
+    """ISSUE 9 satellite: 2 promote/rollback threads x 4 ``infer_one``
+    callers x health readers.  Every served result must be bit-identical
+    to ONE of the two versions' direct results for its frame (in-flight
+    requests drain on the version they resolved — never a mix), and the
+    outcome accounting stays exact throughout."""
+    m = SceneManifest()
+    m.add(scenes[1])
+    m.add(scenes[2], activate=False)
+    reg = SceneRegistry(m, health=HealthPolicy(window=16, min_samples=8))
+    cfg = dataclasses.replace(CFG, serve_max_wait_ms=1.0,
+                              serve_queue_depth=64)
+    frames = [_frame(i) for i in range(4)]
+
+    # Ground truth per version, served directly.
+    want = {}
+    for v in (1, 2):
+        solo = SceneRegistry(SceneManifest())
+        solo.manifest.add(scenes[v])
+        sdisp = solo.dispatcher(cfg, start_worker=False)
+        want[v] = [sdisp.infer_one(f, scene="a") for f in frames]
+
+    disp = reg.dispatcher(cfg, start_worker=False)
+    for f in frames:
+        disp.infer_one(f, scene="a")  # compile + warm before the race
+    disp.start()
+
+    stop = threading.Event()
+    errors: list = []
+    results: list = []
+    rlock = threading.Lock()
+
+    def caller(tid):
+        i = 0
+        while not stop.is_set():
+            try:
+                out = disp.infer_one(frames[(tid + i) % 4], scene="a",
+                                     timeout=60.0)
+                with rlock:
+                    results.append(((tid + i) % 4, out))
+            except Exception as e:  # noqa: BLE001 — the drill fails on any
+                errors.append(e)
+                return
+            i += 1
+
+    def flipper(seed):
+        rng = np.random.RandomState(seed)
+        while not stop.is_set():
+            try:
+                if rng.rand() < 0.5:
+                    reg.promote("a", 2 if m.active_version("a") == 1 else 1)
+                else:
+                    m.rollback("a")
+            except ManifestError:
+                pass  # nothing to roll back yet: fine
+            time.sleep(0.002)
+
+    def reader():
+        while not stop.is_set():
+            reg.health()
+            disp.slo_totals()
+            disp.dispatch_totals()
+            time.sleep(0.001)
+
+    threads = (
+        [threading.Thread(target=caller, args=(t,)) for t in range(4)]
+        + [threading.Thread(target=flipper, args=(s,)) for s in (0, 1)]
+        + [threading.Thread(target=reader)]
+    )
+    for t in threads:
+        t.start()
+    time.sleep(4.0)
+    stop.set()
+    for t in threads:
+        t.join(60.0)
+        assert not t.is_alive(), "thread stranded"
+    assert errors == [], errors
+    disp.close()
+
+    # Every result is EXACTLY one version's result for its frame.
+    assert len(results) > 20
+    mixed = 0
+    for idx, out in results:
+        m1 = _bitwise_equal(out, want[1][idx])
+        m2 = _bitwise_equal(out, want[2][idx])
+        if not (m1 or m2):
+            mixed += 1
+    assert mixed == 0, f"{mixed}/{len(results)} results match neither version"
+    # Accounting exact: all offered requests resolved into outcomes.
+    t = disp.slo_totals()
+    assert (t["served"] + t["shed"] + t["expired"] + t["degraded"]
+            + t["failed"] + t["pending"] == t["offered"]), t
+    assert t["pending"] == 0
+    # No trips: both versions are healthy — the breaker stayed quiet.
+    assert all(v["tripped"] is None
+               for v in reg.health()["scenes"].values())
+
+
+@pytest.mark.slow
+def test_heavy_nan_version_trips_at_sparse_large_bucket(scenes):
+    """Review finding drill (padding-dilution claim): with a LARGE frame
+    bucket and single-frame traffic, most physical lanes are padding.
+    Padding repeats the last real frame through the SAME weights, so a
+    NaN-weight version poisons every lane — the breaker must still trip
+    and roll back; bucket occupancy cannot dilute a (scene, version)
+    weight fault below the threshold."""
+    cfg8 = dataclasses.replace(CFG, frame_buckets=(8,))
+    m = SceneManifest()
+    m.add(dataclasses.replace(scenes[1], ransac=cfg8))
+    m.add(dataclasses.replace(scenes[3], ransac=cfg8), activate=False)
+    reg = SceneRegistry(
+        m, health=HealthPolicy(window=8, min_samples=4, trip_bad_frac=0.5)
+    )
+    disp = reg.dispatcher(cfg8, start_worker=False)
+    disp.infer_one(_frame(0), scene="a")  # warm: 1 real + 7 padding lanes
+    reg.promote("a", 3)
+    for i in range(6):
+        try:
+            disp.infer_one(_frame(i), scene="a")
+        except SceneUnhealthyError:
+            pass
+        if m.active_version("a") == 1:
+            break
+    assert m.active_version("a") == 1, (
+        "NaN version never tripped at sparse bucket occupancy"
+    )
+    assert reg.health()["scenes"]["a@v3"]["bad_frac"] == 1.0
+
+
+def test_canary_whose_version_fails_to_load_rolls_back():
+    """Review finding: a canary version that fails at LOAD time (corrupt
+    checkpoint — no successful dispatch, so no probes) must still
+    resolve: failed dispatches count as bad health samples, so the
+    breaker trips the canary and drops the route instead of letting it
+    dangle (and fail its traffic share) forever."""
+    preset = ScenePreset(height=16, width=16, num_experts=2, gated=False)
+    m = SceneManifest()
+    for v in (1, 2):
+        m.add(SceneEntry(scene_id="s", version=v, expert_ckpt=f"/ck{v}",
+                         preset=preset), activate=False)
+
+    def loader(entry):
+        if entry.version == 2:
+            raise ChecksumMismatchError("s v2: corrupt weights")
+        return {"w": np.zeros(4, np.float32)}
+
+    reg = SceneRegistry(
+        m, loader=loader,
+        health=HealthPolicy(window=8, min_samples=3, trip_bad_frac=0.5,
+                            canary_min_samples=8),
+    )
+    reg._fn_for = lambda entry, route_k=None, n_hyps=None: (
+        lambda params, batch: _out()
+    )
+    serve = reg.infer_fn()
+    reg.promote("s", 2, canary=0.5)
+    served, failed = 0, 0
+    for _ in range(16):
+        try:
+            serve({}, "s")
+            served += 1
+        except ChecksumMismatchError:
+            failed += 1
+        if not reg.health(drain=False)["canaries"]:
+            break
+    h = reg.health()
+    assert h["canaries"] == {}, "load-dead canary dangled"
+    assert "canary_rollback" in [e["event"] for e in h["events"]]
+    assert m.active_version("s") == 1  # incumbent never left
+    assert failed >= 3 and served >= 1
+    # The incumbent serves 100% of traffic again after the rollback.
+    for _ in range(4):
+        serve({}, "s")
+
+
+def test_plain_promote_refuses_tripped_version_until_release():
+    """Review finding: the canary path refused breaker-tripped versions
+    but plain promote() silently moved the pointer onto them — turning a
+    routine re-promote into a full scene outage (every dispatch shed
+    typed + lane quarantine).  Both paths now demand release_scene."""
+    reg, serve = _stub_registry({1: _out(), 2: _out(bad=True)})
+    reg.promote("s", 2)
+    for _ in range(8):
+        try:
+            serve({}, "s")
+        except SceneUnhealthyError:
+            break
+        if reg.manifest.active_version("s") == 1:
+            break
+    assert reg.manifest.active_version("s") == 1  # rolled back
+    with pytest.raises(ManifestError, match="release_scene"):
+        reg.promote("s", 2)  # plain promote, tripped target: refused
+    reg.release_scene("s", 2)
+    reg.promote("s", 2)  # operator asserted the fix: allowed
+    assert reg.manifest.active_version("s") == 2
+
+
+def test_plain_promote_refuses_while_canary_in_flight():
+    """Review finding: plain promote() neither refused nor cancelled an
+    in-flight canary — the stale canary's eventual finalize is a
+    manifest.promote of ITS version, silently reverting the operator's
+    newer pointer move (recorded only as a routine 'canary_promoted').
+    Plain promote now refuses; release_scene cancels the canary first."""
+    reg, serve = _stub_registry({1: _out(), 2: _out(), 3: _out()},
+                                n_versions=3)
+    reg.promote("s", 2, canary=0.5)
+    with pytest.raises(ManifestError, match="canary in flight"):
+        reg.promote("s", 3)  # the urgent-fix promote: refused, not lost
+    assert reg.manifest.active_version("s") == 1
+    # The canary is still in flight and healthy traffic still serves.
+    serve({}, "s")
+    assert reg.health(drain=False)["canaries"]["s"]["version"] == 2
+    reg.release_scene("s")  # operator cancels the canary explicitly...
+    reg.promote("s", 3)     # ...and the newer promote goes through
+    assert reg.manifest.active_version("s") == 3
+    # No stale finalize can revert it: the canary route is gone.
+    for _ in range(12):
+        serve({}, "s")
+    assert reg.manifest.active_version("s") == 3
+    assert "canary_promoted" not in [
+        e["event"] for e in reg.health()["events"]]
+
+
+def test_failure_samples_weigh_the_dispatch_frame_count():
+    """Review finding: a failed dispatch used to weigh (1, 1) while a
+    healthy probe weighs bucket-size frames — at a large bucket an
+    intermittently load-dead scene diluted to bad_frac ~1/B and could
+    never reach trip_bad_frac.  The failure sample now carries the
+    dispatch's frame count."""
+    B = 64
+    reg, serve = _stub_registry(
+        {1: _out(n=B), 2: _out(n=B)},
+        policy=HealthPolicy(window=16, min_samples=2 * B,
+                            trip_bad_frac=0.5, auto_rollback=False))
+    calls = {"n": 0}
+    real_get = reg.cache.get
+
+    def flaky_get(entry):
+        calls["n"] += 1
+        if calls["n"] % 2 == 1:
+            raise SceneLoadError("injected flaky store")
+        return real_get(entry)
+
+    reg.cache.get = flaky_get
+    batch = {"image": np.zeros((B, 4, 4, 3), np.float32)}
+    tripped = False
+    for _ in range(12):
+        try:
+            serve(batch, "s")
+        except SceneLoadError:
+            pass
+        except SceneUnhealthyError:
+            tripped = True
+            break
+    assert tripped, "50%-failing scene at bucket 64 never tripped"
+    stats = reg.health(drain=False)["scenes"]["s@v1"]
+    # Failure samples weigh B frames each — the window's bad fraction
+    # reflects the true 50% failure rate, not ~1/B.
+    assert stats["bad_frac"] >= 0.4, stats
+
+
+def test_batch_frames_prefers_frame_major_leaves():
+    bf = SceneRegistry._batch_frames
+    assert bf({"image": np.zeros((8, 4, 4, 3))}) == 8
+    assert bf({"coords_all": np.zeros((3, 5, 2))}) == 3
+    # An old-style raw PRNG key (shape (2,)) must not masquerade as the
+    # frame count when a named frame-major leaf exists.
+    assert bf({"key": np.zeros(2, np.uint32),
+               "image": np.zeros((6, 4, 4, 3))}) == 6
+    assert bf({}) == 1
+    assert bf({"f": np.float32(20.0)}) == 1
+
+
+def test_caller_input_errors_do_not_poison_the_breaker():
+    """Review finding: a bad caller override (n_hyps=0, invalid route_k)
+    raises during PROGRAM RESOLUTION — the caller's fault, not the
+    version's — and must not feed the health window: one misbehaving
+    client could otherwise trip (and roll back) a healthy rollout."""
+    reg, serve = _stub_registry({1: _out(), 2: _out()},
+                                policy=HealthPolicy(window=8, min_samples=2,
+                                                    trip_bad_frac=0.5))
+    real_stub = reg._fn_for
+
+    def fn_for(entry, route_k=None, n_hyps=None):
+        if n_hyps is not None and n_hyps < 1:
+            raise ValueError(f"n_hyps override must be >= 1, got {n_hyps}")
+        return real_stub(entry, route_k, n_hyps)
+
+    reg._fn_for = fn_for
+    for _ in range(6):
+        with pytest.raises(ValueError, match="n_hyps"):
+            serve({}, "s", n_hyps=0)
+    h = reg.health()
+    assert h["scenes"].get("s@v1", {"bad": 0})["bad"] == 0
+    assert all(v["tripped"] is None for v in h["scenes"].values())
+    serve({}, "s")  # the scene itself is perfectly healthy
+    assert reg.manifest.active_version("s") == 1
+
+
+def test_sharded_registry_path_rides_the_breaker(monkeypatch):
+    """Review finding: make_registry_sharded_serve_fn used to bypass the
+    breaker (manifest.resolve + cache.get directly) — a tripped or
+    NaN-poisoned version kept serving on the sharded path.  It now rides
+    the same resolution/probe layer as infer_fn()."""
+    import esac_tpu.parallel.esac_sharded as sharded
+
+    def fake_maker(mesh, cfg):
+        def infer(batch, c):
+            return _out(bad=True)
+
+        infer._cache_size = lambda: 1
+        return infer
+
+    monkeypatch.setattr(
+        sharded, "make_esac_infer_sharded_frames_dynamic", fake_maker
+    )
+    from esac_tpu.registry import make_registry_sharded_serve_fn
+
+    preset = ScenePreset(height=16, width=16, num_experts=2, gated=False)
+    m = SceneManifest()
+    m.add(SceneEntry(scene_id="s", version=1, expert_ckpt="/ck",
+                     preset=preset))
+    reg = SceneRegistry(
+        m, loader=lambda e: {"c": np.asarray([8.0, 8.0])},
+        health=HealthPolicy(window=8, min_samples=4, trip_bad_frac=0.5),
+    )
+    serve = make_registry_sharded_serve_fn(None, reg, CFG)
+    tripped = False
+    for _ in range(6):
+        try:
+            serve({}, "s")
+        except SceneUnhealthyError:
+            tripped = True
+            break
+    assert tripped, "sharded path never tripped on all-NaN winners"
+    assert reg.health()["scenes"]["s@v1"]["tripped"] is not None
+    # Probes were recorded through the sharded entry.
+    assert reg.health()["scenes"]["s@v1"]["frames"] > 0
+
+
+def test_cache_clear_is_not_resurrected_by_inflight_load():
+    """Review finding: with loads off the lock, a load straddling
+    clear() used to re-insert its tree afterwards — a 'cleared' cache
+    silently holding device weights.  The load's CALLER still gets the
+    tree; residency stays cleared (generation check)."""
+    release = threading.Event()
+
+    @dataclasses.dataclass(frozen=True)
+    class E:
+        scene_id: str = "s"
+
+        @property
+        def key(self):
+            return ("s", 1)
+
+    started = threading.Event()
+
+    def loader(entry):
+        started.set()
+        release.wait()
+        return {"w": np.zeros(4, np.float32)}
+
+    cache = DeviceWeightCache(loader)
+    got = {}
+
+    def getter():
+        got["tree"] = cache.get(E())
+
+    t = threading.Thread(target=getter)
+    t.start()
+    assert started.wait(5.0)
+    cache.clear()        # while the load is in flight
+    release.set()
+    t.join(10.0)
+    assert not t.is_alive()
+    assert got["tree"] is not None      # caller still served
+    assert len(cache) == 0              # ...but the cache stays cleared
+    assert cache.keys() == []
+    cache.get(E())                      # next get is a clean miss
+    assert len(cache) == 1

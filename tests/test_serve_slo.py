@@ -930,3 +930,36 @@ def test_heavy_open_loop_stall_recovery_accounting_and_bit_parity():
                                   np.asarray(w[key])), key
     disp.close()
     _totals_consistent(disp)
+
+
+def test_run_open_loop_records_typed_error_classes():
+    """ISSUE 9: the open-loop record carries WHICH typed error ended each
+    non-served request (the chaos drill's per-fault accounting keys on
+    it), aligned with per_request_outcomes."""
+    release = threading.Event()
+
+    def gated(tree, scene=None, route_k=None):
+        release.wait()
+        return {"echo": tree["x"]}
+
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,), serve_max_wait_ms=0.0,
+                              serve_queue_depth=2)
+    disp = MicroBatchDispatcher(gated, cfg, slo=SLOPolicy())
+    threading.Timer(0.3, release.set).start()
+    res = run_open_loop(
+        disp,
+        lambda i: (_frame(i), None, None),
+        uniform_arrivals(200.0, 20),  # floods the depth-2 queue: sheds
+        deadline_ms=5_000.0,
+        hyps_per_request=1,
+    )
+    disp.close()
+    errs = res["per_request_error_types"]
+    outs = res["per_request_outcomes"]
+    assert len(errs) == len(outs) == 20
+    assert res["outcomes"]["shed"] > 0
+    for o, e in zip(outs, errs):
+        if o == "shed":
+            assert e == "ShedError", (o, e)
+        elif o == "served":
+            assert e is None, (o, e)
