@@ -86,6 +86,14 @@ ROUTED_HW = 96           # image size: the expert CNNs must dominate for the
                          # (routing buys CNN sparsity, not hypothesis work)
 ROUTED_REPEATS = 5       # median-of-5 per leg (CPU jitter, cf. serve bench)
 
+OBS_FRAMES = 24          # requests per timed pass of the obs overhead gate
+OBS_HYPS = 16            # per-request hypotheses: the serve operating point
+                         # (cf. SERVE_HYPS) so the traced path carries a
+                         # realistic compute-to-bookkeeping ratio
+OBS_REPEATS = 9          # interleaved off/on passes; the ~20% CPU run
+                         # jitter needs medians over many pairs for a
+                         # sub-3% overhead verdict to mean anything
+
 CHAOS_M = 2              # experts in the chaos drill's synthetic scenes
 CHAOS_HW = 24            # tiny frames: the drill measures FAULT routing
                          # and recovery, not throughput (cf. loadtest)
@@ -105,6 +113,7 @@ _ROUTED_FILE = _REPO / ".routed_serve.json"
 _LOADTEST_FILE = _REPO / ".serve_loadtest.json"
 _SCORING_FILE = _REPO / ".scoring_fused.json"
 _CHAOS_FILE = _REPO / ".chaos_drill.json"
+_OBS_FILE = _REPO / ".obs_overhead.json"
 
 
 def _measure_jax(
@@ -1290,6 +1299,180 @@ def _measure_chaos_at(root: pathlib.Path, seconds: float) -> dict:
     }
 
 
+def _measure_obs(
+    n_frames: int = OBS_FRAMES,
+    n_hyps: int = OBS_HYPS,
+    repeats: int = OBS_REPEATS,
+) -> dict:
+    """Observability overhead gate (DESIGN.md §14): the SAME jitted serve
+    program driven through the request path with tracing OFF vs ON, in
+    interleaved passes (medians, spread recorded — the off/on pairs ride
+    identical container weather).  The acceptance gate: tracing-on
+    throughput within 3% of tracing-off, and ZERO additional compiled
+    programs (tracing is pure host bookkeeping; the jit cache-miss
+    counter proves it never touched the compiled surface).
+
+    Two evidence legs ride along:
+
+    - span integrity: a traced worker dispatcher serves a batch of
+      submitted requests and every request's per-stage durations must
+      sum (math.fsum) to its measured end-to-end latency — the
+      telescoping invariant the span model promises (max residual
+      recorded; the per-stage p50 table feeds DESIGN.md §14);
+    - export: the fleet ``obs.snapshot()`` must round-trip
+      ``json.dumps`` (asserted, and the snapshot itself is embedded in
+      the artifact as the provenance block's fleet view).
+    """
+    import math
+
+    import jax
+    import numpy as np
+
+    from esac_tpu.data import CAMERA_F, make_correspondence_frame
+    from esac_tpu.obs import STAGES
+    from esac_tpu.ransac import RansacConfig
+    from esac_tpu.serve import MicroBatchDispatcher, make_dsac_serve_fn
+
+    cfg = RansacConfig(n_hyps=n_hyps, frame_buckets=(1,))
+    fn = make_dsac_serve_fn(C, cfg)
+    keys = jax.random.split(jax.random.key(0), n_frames)
+    frames = [
+        {
+            "key": jax.random.fold_in(jax.random.key(1), i),
+            "coords": np.asarray(fr["coords"]),
+            "pixels": np.asarray(fr["pixels"]),
+            "f": np.float32(CAMERA_F),
+        }
+        for i, fr in enumerate(
+            make_correspondence_frame(k, noise=0.01, outlier_frac=0.3)
+            for k in keys
+        )
+    ]
+
+    # One shared program: compile+warm once, then count compiled programs
+    # around the whole traced sweep.
+    warm = MicroBatchDispatcher(fn, cfg, start_worker=False)
+    warm.infer_one(frames[0])
+    compiled_before = warm.cache_size()
+
+    def timed_pass(trace):
+        disp = MicroBatchDispatcher(fn, cfg, start_worker=False,
+                                    trace=trace)
+        t0 = time.perf_counter()
+        for fr in frames:
+            disp.infer_one(fr)
+        dt = time.perf_counter() - t0
+        return dt, disp
+
+    import gc
+
+    offs, ons, q_offs, q_ons = [], [], [], []
+    for _ in range(repeats):
+        # A gen-2 GC pause mid-pass reads as overhead on whichever leg it
+        # lands; pay it between passes (the loadtest precedent).
+        gc.collect()
+        dt, d = timed_pass(False)
+        offs.append(dt)
+        q_offs.append(d.latency_quantiles())
+        gc.collect()
+        dt, d = timed_pass(True)
+        ons.append(dt)
+        q_ons.append(d.latency_quantiles())
+
+    def med(xs):
+        return sorted(xs)[len(xs) // 2]
+
+    med_off, med_on = med(offs), med(ons)
+    # Per-leg p50/p99 are MEDIANS ACROSS PASSES, consistent with the
+    # medians-over-pairs wall protocol — a single contended final pass
+    # must not stand in as the leg's latency evidence (review finding).
+    q_off = {p: med([q[p] for q in q_offs]) for p in (0.5, 0.99)}
+    q_on = {p: med([q[p] for q in q_ons]) for p in (0.5, 0.99)}
+    # The gate statistic is the MEDIAN OF PER-PAIR RATIOS, not the ratio
+    # of medians: each interleaved (off, on) pair shares container
+    # weather, so a single contended pass (this box's ~20% run jitter,
+    # see _contention_block) skews one pair's ratio and the median
+    # discards it — the ratio of independent medians would let one
+    # outlier on either side masquerade as tracing overhead.
+    pair_ratios = sorted(on / off for off, on in zip(offs, ons))
+
+    def leg(dt_med, spread, q):
+        return {
+            "wall_s_median": round(dt_med, 4),
+            "wall_s_spread": [round(x, 4) for x in sorted(spread)],
+            "requests_per_s": round(n_frames / dt_med, 1),
+            "hyps_per_s": round(n_frames * n_hyps / dt_med, 1),
+            "p50_ms": round(q[0.5] * 1e3, 2),
+            "p99_ms": round(q[0.99] * 1e3, 2),
+        }
+
+    # Span integrity + the unified snapshot: a traced WORKER dispatcher
+    # (the queued path, so coalesced/queue time is real) serving every
+    # frame once.
+    dispw = MicroBatchDispatcher(fn, cfg, trace=True)
+    reqs = [dispw.submit(fr) for fr in frames]
+    for r in reqs:
+        r.get(300.0)
+    residuals = [
+        abs(math.fsum(r.spans.durations().values())
+            - (r.t_done - r.t_submit))
+        for r in reqs
+    ]
+    stage_hist = dispw.obs.get("serve_stage_seconds")
+    stage_p50_ms = {
+        stage: round(stage_hist.quantile(0.5, stage=stage) * 1e3, 3)
+        for stage in list(STAGES[1:]) + ["served"]
+        if stage_hist.count(stage=stage)
+    }
+    snapshot = dispw.obs.snapshot()
+    snapshot_json_ok = True
+    try:
+        json.dumps(snapshot)
+    except (TypeError, ValueError):
+        snapshot_json_ok = False
+    compiled_after = dispw.cache_size()
+    dispw.close()
+
+    ratio_wall = med(pair_ratios)      # on-wall / off-wall, pair-median
+    ratio = 1.0 / ratio_wall           # on-throughput / off-throughput
+    overhead_pct = (ratio_wall - 1.0) * 100.0
+    return {
+        "n_frames": n_frames,
+        "n_hyps_per_frame": n_hyps,
+        "repeats": repeats,
+        "tracing_off": leg(med_off, offs, q_off),
+        "tracing_on": leg(med_on, ons, q_on),
+        "overhead_pct": round(overhead_pct, 2),
+        "pair_wall_ratios": [round(r, 4) for r in pair_ratios],
+        "throughput_ratio_on_over_off": round(ratio, 4),
+        "within_3pct": bool(ratio >= 0.97),
+        "compiled_programs": {
+            "before": compiled_before,
+            "after_traced_sweep": compiled_after,
+            "jit_cache_misses_added": compiled_after - compiled_before,
+        },
+        "span_integrity": {
+            "requests_checked": len(reqs),
+            "max_abs_residual_s": max(residuals),
+            "sums_match_e2e": bool(max(residuals) < 1e-6),
+        },
+        "stage_p50_ms": stage_p50_ms,
+        "snapshot_json_ok": snapshot_json_ok,
+        "obs_snapshot": snapshot,
+        "note": (
+            "same compiled program for every leg; off/on passes "
+            "interleaved and the overhead verdict is the MEDIAN OF "
+            "PER-PAIR wall ratios (one contended pass cannot masquerade "
+            "as tracing overhead; raw spreads recorded); per-leg "
+            "p50/p99 are medians across all passes, same protocol; "
+            "stage_p50_ms durations are "
+            "attributed to the stage REACHED (the 'served' row is the "
+            "sliced->finish fan-out gap); span residual is the "
+            "telescoping-sum check over every traced request"
+        ),
+    }
+
+
 def _measure_cpp() -> float | None:
     import jax
     import numpy as np
@@ -1414,6 +1597,8 @@ def device_child(kwargs: dict) -> None:
         payload = {"scoring": _measure_scoring(**kwargs)}
     elif kwargs.pop("chaos", False):
         payload = {"chaos": _measure_chaos(**kwargs)}
+    elif kwargs.pop("obs", False):
+        payload = {"obs": _measure_obs(**kwargs)}
     else:
         payload = {"rate": _measure_jax(**kwargs)}
     import jax
@@ -1800,10 +1985,19 @@ def _driver_main(stopped: list[int], load_before: list[float], *,
     if device_kind:
         out["device_kind"] = device_kind
     out["contention"] = _contention_block(stopped, load_before)
+    # Observability provenance (ISSUE 10): every scaffold artifact records
+    # the obs schema that accompanies it; modes that ran a fleet (bench.py
+    # obs) embed their full obs.snapshot() as the fleet view.
+    from esac_tpu.obs import provenance
+
     artifact = {
         **out,
         "platform": platform,
         "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "obs_provenance": provenance(
+            payload.get("obs_snapshot") if isinstance(payload, dict)
+            else None
+        ),
     }
     tmp = str(artifact_path) + ".tmp"
     with open(tmp, "w") as fh:
@@ -1941,6 +2135,28 @@ def _chaos_main(stopped: list[int], load_before: list[float]) -> None:
                  artifact_path=_CHAOS_FILE, headline=_chaos_headline)
 
 
+def _obs_headline(obs: dict) -> dict:
+    return {
+        "metric": "obs_tracing_overhead_pct",
+        "value": obs["overhead_pct"],
+        "unit": "%",
+        "vs_baseline": None,
+        "within_3pct": obs["within_3pct"],
+        "jit_cache_misses_added":
+            obs["compiled_programs"]["jit_cache_misses_added"],
+        "span_sums_match_e2e": obs["span_integrity"]["sums_match_e2e"],
+        "snapshot_json_ok": obs["snapshot_json_ok"],
+    }
+
+
+def _obs_main(stopped: list[int], load_before: list[float]) -> None:
+    """``python bench.py obs`` — the ISSUE 10 observability overhead gate
+    (DESIGN.md §14) through the shared scaffold (.obs_overhead.json)."""
+    _driver_main(stopped, load_before, key="obs", what="obs overhead gate",
+                 measure_cpu=lambda: _measure_obs(),
+                 artifact_path=_OBS_FILE, headline=_obs_headline)
+
+
 def _main_measured(stopped: list[int], load_before: list[float]) -> None:
     modes = {
         "serve": _serve_main,
@@ -1949,6 +2165,7 @@ def _main_measured(stopped: list[int], load_before: list[float]) -> None:
         "loadtest": _loadtest_main,
         "scoring": _scoring_main,
         "chaos": _chaos_main,
+        "obs": _obs_main,
     }
     if len(sys.argv) > 1 and sys.argv[1] in modes:
         modes[sys.argv[1]](stopped, load_before)

@@ -8,8 +8,10 @@ convention — every piece of mutable shared state is touched only under the
 instance lock — which until now was prose in docstrings and a couple of
 regression tests.
 
-R10 checks it structurally, per class in ``esac_tpu/serve/`` and
-``esac_tpu/registry/``:
+R10 checks it structurally, per class in ``esac_tpu/serve/``,
+``esac_tpu/registry/`` and ``esac_tpu/obs/`` (the metric instruments and
+the unified registry are read by monitor threads while serving threads
+publish — ISSUE 10 put them under the same discipline):
 
 - **Locks**: instance attributes assigned ``threading.Lock()`` /
   ``RLock()`` in ``__init__``, plus ``threading.Condition(...)`` aliases —
@@ -53,7 +55,9 @@ _EXEMPT_METHODS = {"__init__", "__new__", "__del__"}
 
 
 def _r10_scope(rel: str) -> bool:
-    return rel.startswith(("esac_tpu/serve/", "esac_tpu/registry/"))
+    return rel.startswith(
+        ("esac_tpu/serve/", "esac_tpu/registry/", "esac_tpu/obs/")
+    )
 
 
 def _self_attr(node) -> str | None:

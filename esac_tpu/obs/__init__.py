@@ -1,0 +1,40 @@
+"""Fleet observability (DESIGN.md §14): tracing, metrics, export.
+
+Observability as a LAYER, not another ring buffer: one
+:class:`MetricsRegistry` that the dispatcher, SLO layer, scene registry,
+health breakers and weight cache all publish into; request-scoped
+:class:`SpanChain` tracing stamped at the dispatcher's existing choke
+points (gated — the hot path with tracing off is unchanged, and with it
+on gains zero host syncs and zero jit interactions); and one export
+surface — a locked ``json.dumps``-able ``snapshot()``, a
+Prometheus-style text page, the ``python -m esac_tpu.obs`` dump CLI and
+the ``python bench.py obs`` overhead gate behind ``.obs_overhead.json``.
+
+Pure host package: importing it never touches jax or the TPU relay.
+"""
+
+from esac_tpu.obs.export import jsonable, provenance, render_prometheus
+from esac_tpu.obs.metrics import (
+    OBS_SCHEMA,
+    CounterVec,
+    GaugeVec,
+    HistogramVec,
+    MetricsRegistry,
+    StreamingHistogram,
+)
+from esac_tpu.obs.trace import SpanChain, STAGES, TERMINAL_STAGES
+
+__all__ = [
+    "OBS_SCHEMA",
+    "CounterVec",
+    "GaugeVec",
+    "HistogramVec",
+    "MetricsRegistry",
+    "SpanChain",
+    "STAGES",
+    "StreamingHistogram",
+    "TERMINAL_STAGES",
+    "jsonable",
+    "provenance",
+    "render_prometheus",
+]

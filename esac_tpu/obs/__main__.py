@@ -1,0 +1,114 @@
+"""``python -m esac_tpu.obs`` — dump a fleet snapshot.
+
+Reads an obs snapshot and renders it as Prometheus text (default) or
+pretty JSON.  Sources, in order:
+
+- ``--file PATH``: a JSON file that is either a bare ``snapshot()`` dict
+  (has a ``metrics`` key) or a bench artifact carrying one (the
+  ``obs_provenance.fleet`` block every ``_driver_main`` artifact embeds,
+  or the obs mode's ``obs.obs_snapshot`` payload field);
+- no flag: the committed ``.obs_overhead.json`` next to the repo's
+  ``bench.py`` (the zero-setup "what does the fleet look like" answer);
+- ``--demo``: run a tiny in-process echo fleet (forcing the CPU backend
+  FIRST — CLAUDE.md: an ad-hoc interpreter touching jax while the relay
+  is unhealthy becomes a second stuck process) and dump its live
+  snapshot, tracing on.
+
+Exit status 2 when no snapshot can be located.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+
+def _extract_snapshot(doc: dict) -> dict | None:
+    """Find a snapshot dict inside a bare snapshot or a bench artifact."""
+    if not isinstance(doc, dict):
+        return None
+    if "metrics" in doc and "obs_schema" in doc:
+        return doc
+    prov = doc.get("obs_provenance")
+    if isinstance(prov, dict) and isinstance(prov.get("fleet"), dict):
+        return prov["fleet"]
+    obs = doc.get("obs")
+    if isinstance(obs, dict) and isinstance(obs.get("obs_snapshot"), dict):
+        return obs["obs_snapshot"]
+    return None
+
+
+def _demo_snapshot() -> dict:
+    """A tiny live fleet on the CPU backend: echo infer fn, traced
+    dispatcher, a few mixed-scene requests — enough to exercise every
+    instrument the dispatcher publishes."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from esac_tpu.ransac.config import RansacConfig
+    from esac_tpu.serve.dispatcher import MicroBatchDispatcher
+
+    def echo(tree, scene=None, route_k=None):
+        return {"echo": tree["x"]}
+
+    cfg = RansacConfig(frame_buckets=(1, 4), serve_max_wait_ms=1.0)
+    disp = MicroBatchDispatcher(echo, cfg, trace=True)
+    try:
+        reqs = [
+            disp.submit({"x": np.full(2, i, np.float32)},
+                        scene=f"s{i % 2}")
+            for i in range(8)
+        ]
+        for r in reqs:
+            r.get(30.0)
+    finally:
+        disp.close()
+    return disp.obs.snapshot()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m esac_tpu.obs",
+        description="dump an esac_tpu fleet observability snapshot",
+    )
+    ap.add_argument("--file", type=pathlib.Path, default=None,
+                    help="snapshot JSON or bench artifact carrying one")
+    ap.add_argument("--format", choices=("prom", "json"), default="prom")
+    ap.add_argument("--demo", action="store_true",
+                    help="run a tiny in-process CPU fleet and dump it")
+    args = ap.parse_args(argv)
+
+    if args.demo:
+        snap = _demo_snapshot()
+    else:
+        path = args.file
+        if path is None:
+            path = (pathlib.Path(__file__).resolve().parents[2]
+                    / ".obs_overhead.json")
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, ValueError) as e:
+            print(f"no readable snapshot at {path}: {e}", file=sys.stderr)
+            return 2
+        snap = _extract_snapshot(doc)
+        if snap is None:
+            print(f"{path} carries no obs snapshot "
+                  "(expected a snapshot dict, obs_provenance.fleet, or "
+                  "obs.obs_snapshot)", file=sys.stderr)
+            return 2
+
+    if args.format == "json":
+        print(json.dumps(snap, indent=1, sort_keys=True))
+    else:
+        from esac_tpu.obs.export import render_prometheus
+
+        sys.stdout.write(render_prometheus(snap))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

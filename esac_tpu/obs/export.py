@@ -1,0 +1,127 @@
+"""Export surface: JSON sanitation, Prometheus text, artifact provenance.
+
+``MetricsRegistry.snapshot()`` delegates here for :func:`jsonable` (the
+fleet snapshot contract is ``json.dumps(snapshot)`` NEVER raises — lane
+tuples, numpy scalars and deque-shaped collector output all sanitize);
+:func:`render_prometheus` turns a snapshot into the text exposition
+format scrapers expect; :func:`provenance` is the block ``bench.py``'s
+``_driver_main`` scaffold embeds in EVERY committed artifact so each one
+records which obs schema produced it (and, for modes that ran a fleet,
+the full snapshot).
+
+Pure host code, no jax import (CLAUDE.md: observability must never
+become a TPU relay client).
+"""
+
+from __future__ import annotations
+
+import math
+
+from esac_tpu.obs.metrics import OBS_SCHEMA
+
+
+def jsonable(obj):
+    """Recursively convert ``obj`` into something ``json.dumps`` accepts:
+    non-string dict keys stringify, tuples/sets/deques become lists,
+    numpy scalars unwrap via ``.item()``, and anything else falls back to
+    ``repr`` — a snapshot must never raise on one odd leaf."""
+    if obj is None or isinstance(obj, (bool, int, str)):
+        return obj
+    if isinstance(obj, float):
+        return obj  # json emits NaN/Infinity tokens, matching bench.py
+    if isinstance(obj, dict):
+        return {
+            (k if isinstance(k, str) else str(k)): jsonable(v)
+            for k, v in obj.items()
+        }
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        return [jsonable(v) for v in obj]
+    item = getattr(obj, "item", None)
+    if callable(item) and getattr(obj, "shape", None) in ((), None):
+        try:
+            return jsonable(item())
+        except Exception:  # noqa: BLE001 — fall through to repr
+            pass
+    if hasattr(obj, "__iter__"):
+        try:
+            return [jsonable(v) for v in obj]
+        except Exception:  # noqa: BLE001 — fall through to repr
+            pass
+    return repr(obj)
+
+
+def _prom_escape(v) -> str:
+    s = str(v)
+    return s.replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+def _prom_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_prom_escape(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def _prom_value(v) -> str:
+    if v is None:
+        return "NaN"
+    if isinstance(v, float) and not math.isfinite(v):
+        return "NaN" if math.isnan(v) else ("+Inf" if v > 0 else "-Inf")
+    return repr(float(v))
+
+
+def render_prometheus(snapshot: dict) -> str:
+    """Prometheus text exposition of a :meth:`MetricsRegistry.snapshot`
+    dict.  Counters/gauges render directly; histograms render as
+    summaries (quantile-labeled samples + ``_count``/``_sum``).
+    Structured collector blocks are not flattenable into samples and are
+    listed as comments so the page still names every surface."""
+    lines = [f"# esac_tpu obs schema {snapshot.get('obs_schema')}"]
+    for name, m in sorted(snapshot.get("metrics", {}).items()):
+        kind = m.get("kind", "untyped")
+        if m.get("help"):
+            lines.append(f"# HELP {name} {m['help']}")
+        lines.append(
+            f"# TYPE {name} {'summary' if kind == 'histogram' else kind}"
+        )
+        for s in m.get("samples", []):
+            labels = s.get("labels", {})
+            if kind == "histogram":
+                for k, v in s.items():
+                    if k.startswith("p") and k[1:].isdigit():
+                        q = int(k[1:]) / 100.0
+                        lines.append(
+                            f"{name}{_prom_labels({**labels, 'quantile': q})}"
+                            f" {_prom_value(v)}"
+                        )
+                lines.append(
+                    f"{name}_count{_prom_labels(labels)} "
+                    f"{_prom_value(s.get('count', 0))}"
+                )
+                lines.append(
+                    f"{name}_sum{_prom_labels(labels)} "
+                    f"{_prom_value(s.get('sum', 0.0))}"
+                )
+            else:
+                lines.append(
+                    f"{name}{_prom_labels(labels)} "
+                    f"{_prom_value(s.get('value'))}"
+                )
+    for cname in sorted(snapshot.get("collectors", {})):
+        lines.append(f"# COLLECTOR {cname} (structured; see JSON snapshot)")
+    return "\n".join(lines) + "\n"
+
+
+def provenance(fleet_snapshot: dict | None = None) -> dict:
+    """The obs provenance block every bench artifact embeds: the schema
+    version that produced it plus, when the measured mode ran a fleet,
+    its full ``obs.snapshot()``."""
+    out = {
+        "obs_schema": OBS_SCHEMA,
+        "has_fleet_snapshot": fleet_snapshot is not None,
+    }
+    if fleet_snapshot is not None:
+        out["fleet"] = jsonable(fleet_snapshot)
+    return out

@@ -1,0 +1,103 @@
+"""Request-scoped span chains (DESIGN.md §14): where a request's time went.
+
+A traced request carries ONE :class:`SpanChain`: an append-only list of
+(stage, monotonic-timestamp) stamps written at the dispatcher's existing
+choke points — no new threads, no device syncs, no allocation beyond the
+stamp tuples.  The canonical stage sequence of a served request:
+
+  ``admitted``   — ``submit()`` accepted the request (its ``t_submit``)
+  ``coalesced``  — the worker popped it into a dispatch batch
+  ``staged``     — the batch is padded, stacked and handed to device_put
+  ``dispatched`` — the async device call was issued
+  ``device``     — ``block_until_ready`` returned (the sync the dispatch
+                   path ALREADY performs — tracing adds zero host syncs,
+                   the PR-9 deferred-probe discipline applied to timing)
+  ``sliced``     — per-request host result trees were cut from the batch
+  ``<outcome>``  — terminal stamp at ``t_done`` (served / degraded /
+                   expired / failed), written by ``_finish``
+
+Each consecutive stamp pair defines one duration, attributed to the LATER
+stage ("time spent reaching it"), so a request that never dispatched
+(expired in queue, failed by the watchdog) still yields a well-formed
+chain — admitted straight to its terminal stage.  Durations telescope:
+their sum is EXACTLY last-stamp minus first-stamp, i.e. the request's
+measured end-to-end latency (``t_done - t_submit``), which is the span
+integrity invariant ``python bench.py obs`` and tests/test_obs.py pin.
+
+A dispatch retry re-stamps staged/dispatched/device for each attempt;
+:meth:`durations` aggregates by stage name, and the telescoping-sum
+property survives because aggregation only regroups the same diffs.
+Chains are written by one thread at a time (the submitter, then the
+worker that owns the batch, then whoever resolves the request under the
+dispatcher lock), so they carry no lock of their own — with ONE
+documented exception: a request abandoned mid-dispatch (caller timeout,
+watchdog) is resolved by its terminal stamp while the wedged worker may
+still be walking the batch, and when that worker unsticks its late
+stage stamps can land AFTER the terminal one.  The read side is
+therefore what owns the invariant: every accessor truncates the chain
+at the FIRST terminal stamp, so late post-terminal writes are inert and
+``fsum(durations) == total == t_done - t_submit`` holds for every
+resolved request, abandoned or not (regression-pinned in
+tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import math
+
+# The non-terminal stages, in dispatch order.
+STAGES = ("admitted", "coalesced", "staged", "dispatched", "device",
+          "sliced")
+# Terminal stamps reuse the outcome-class names of the SLO accounting.
+TERMINAL_STAGES = ("served", "degraded", "shed", "expired", "failed")
+
+
+class SpanChain:
+    """Append-only (stage, t) stamps for one request; see module doc."""
+
+    __slots__ = ("stamps",)
+
+    def __init__(self, stage: str, t: float):
+        self.stamps: list[tuple[str, float]] = [(stage, t)]
+
+    def stamp(self, stage: str, t: float) -> None:
+        self.stamps.append((stage, t))
+
+    def _effective(self) -> list[tuple[str, float]]:
+        """The chain up to (and including) its FIRST terminal stamp —
+        the truncation that makes late post-terminal writes from an
+        abandoned dispatch's worker inert (see module docstring)."""
+        for i, (stage, _) in enumerate(self.stamps):
+            if stage in TERMINAL_STAGES:
+                return self.stamps[:i + 1]
+        return self.stamps
+
+    def total(self) -> float:
+        """First terminal stamp (or last stamp, unresolved) minus first:
+        the chain's end-to-end span."""
+        eff = self._effective()
+        return eff[-1][1] - eff[0][1]
+
+    def segments(self) -> list[tuple[str, float]]:
+        """(stage, dt) per consecutive stamp pair, attributed to the
+        later stage, in stamp order (retries appear as repeats);
+        truncated at the first terminal stamp."""
+        eff = self._effective()
+        out = []
+        for (_, t0), (stage, t1) in zip(eff, eff[1:]):
+            out.append((stage, t1 - t0))
+        return out
+
+    def durations(self) -> dict[str, float]:
+        """Per-stage durations aggregated by stage name.  Their
+        ``math.fsum`` equals :meth:`total` (telescoping — the span
+        integrity pin)."""
+        agg: dict[str, float] = {}
+        for stage, dt in self.segments():
+            agg[stage] = agg.get(stage, 0.0) + dt
+        return agg
+
+    def residual(self) -> float:
+        """|fsum(durations) - total| — 0 up to float summation noise;
+        exported by the bench so the artifact carries the evidence."""
+        return abs(math.fsum(self.durations().values()) - self.total())

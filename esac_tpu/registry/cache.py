@@ -211,6 +211,14 @@ class DeviceWeightCache:
             self._nbytes.clear()
             self._gen += 1
 
+    def bind_obs(self, metrics, name: str = "weight_cache") -> None:
+        """Publish this cache into an obs
+        :class:`~esac_tpu.obs.MetricsRegistry` (DESIGN.md §14) as a pull
+        collector: :meth:`stats` already produces a lock-consistent
+        snapshot, so the unified fleet snapshot reads the same truth the
+        legacy accessor reports.  Idempotent per (registry, name)."""
+        metrics.register_collector(name, self.stats)
+
     def stats(self) -> dict:
         with self._lock:
             return {

@@ -28,6 +28,7 @@ import time
 
 import numpy as np
 
+from esac_tpu.obs import MetricsRegistry
 from esac_tpu.ransac.config import RansacConfig
 from esac_tpu.registry.cache import DeviceWeightCache
 from esac_tpu.registry.health import (
@@ -417,6 +418,7 @@ class SceneRegistry:
         device=None,
         health: HealthPolicy | None = HealthPolicy(),
         clock=time.perf_counter,
+        obs: MetricsRegistry | None = None,
     ):
         self.manifest = manifest
         self.cache = DeviceWeightCache(loader, budget_bytes, device)
@@ -424,6 +426,27 @@ class SceneRegistry:
         self._fns_lock = threading.Lock()
         self._health_policy = health
         self._clock = clock
+        # Observability (DESIGN.md §14): the registry owns its health
+        # instruments and a home obs registry; ``bind_obs`` adopts the
+        # SAME instrument/collector objects into a dispatcher's registry
+        # so one fleet snapshot covers serve + registry + cache (see
+        # :meth:`dispatcher`).
+        self.obs = obs if obs is not None else MetricsRegistry()
+        self._m_probe_frames = self.obs.counter(
+            "registry_probe_frames_total",
+            "health-probe frames folded per (scene, version)",
+        )
+        self._m_bad_frames = self.obs.counter(
+            "registry_unhealthy_frames_total",
+            "non-finite-winner frames per (scene, version)",
+        )
+        self._m_health_events = self.obs.counter(
+            "registry_health_events_total",
+            "breaker/canary events by kind (trips, rollbacks, promotes)",
+        )
+        self.obs.register_collector("scene_health",
+                                    self._health_collector)
+        self.cache.bind_obs(self.obs)
         self._health_lock = threading.Lock()
         # Deferred probes: (key, {leaf name: device array}) per dispatch.
         self._probes: collections.deque = collections.deque()
@@ -698,6 +721,10 @@ class SceneRegistry:
         evaluated = [
             (key, *unhealthy_frames(leaves)) for key, leaves in pending
         ]
+        for key, bad, total in evaluated:
+            self._m_probe_frames.inc(total, scene=key[0], version=key[1])
+            if bad:
+                self._m_bad_frames.inc(bad, scene=key[0], version=key[1])
         actions = []
         with self._health_lock:
             for key, bad, total in evaluated:
@@ -723,6 +750,8 @@ class SceneRegistry:
         ~bucket-fold at large buckets and an intermittently load-dead
         scene could never reach ``trip_bad_frac`` (review finding)."""
         frames = max(1, int(frames))
+        self._m_probe_frames.inc(frames, scene=key[0], version=key[1])
+        self._m_bad_frames.inc(frames, scene=key[0], version=key[1])
         with self._health_lock:
             dq = self._samples.get(key)
             if dq is None:
@@ -824,9 +853,38 @@ class SceneRegistry:
 
     def _record_event(self, kind: str, **fields) -> None:
         with self._health_lock:
+            # Counter and event log move in the same critical section —
+            # a monitor snapshot must never see the counter ahead of the
+            # events list (the dispatcher's _count_* convention).
+            self._m_health_events.inc(event=kind)
             self.health_events.append({
                 "t": self._clock(), "event": kind, **fields,
             })
+
+    def _health_collector(self) -> dict:
+        """The obs pull collector behind ``scene_health``: the same
+        locked :meth:`health` snapshot, WITHOUT draining probes — a
+        monitor scrape must stay read-only and never execute breaker
+        actions on behalf of the serving threads."""
+        if self._health_policy is None:
+            return {"scenes": {}, "canaries": {}, "events": []}
+        return self.health(drain=False)
+
+    def bind_obs(self, metrics: MetricsRegistry) -> None:
+        """Adopt this registry's health instruments + collectors into
+        ``metrics`` (a dispatcher's obs registry), so ONE fleet snapshot
+        covers serve accounting, scene health and the weight cache.  The
+        instrument OBJECTS are shared, not copied — both registries read
+        the same counts.  Idempotent; also safe across several
+        dispatchers over one SceneRegistry (each adopts the same
+        objects)."""
+        if metrics is self.obs:
+            return
+        metrics.register(self._m_probe_frames)
+        metrics.register(self._m_bad_frames)
+        metrics.register(self._m_health_events)
+        metrics.register_collector("scene_health", self._health_collector)
+        self.cache.bind_obs(metrics)
 
     def _resolve_serving(self, scene: str) -> SceneEntry:
         """Breaker- and canary-aware resolution: the manifest's active
@@ -903,12 +961,19 @@ class SceneRegistry:
                    start_worker: bool = True, **kw):
         """A scene-aware MicroBatchDispatcher over this registry.  ``cfg``
         carries the SERVING knobs (frame buckets, wait, depth) — each
-        scene's kernel still runs under its own manifest RansacConfig."""
+        scene's kernel still runs under its own manifest RansacConfig.
+        The registry's health instruments and cache stats are adopted
+        into the dispatcher's obs registry (DESIGN.md §14), so
+        ``disp.obs.snapshot()`` is the unified fleet snapshot; the
+        dispatcher keeps its own PRIVATE serve counters (two dispatchers
+        over one SceneRegistry never alias each other's accounting)."""
         from esac_tpu.serve import MicroBatchDispatcher
 
-        return MicroBatchDispatcher(
+        disp = MicroBatchDispatcher(
             self.infer_fn(), cfg, start_worker=start_worker, **kw
         )
+        self.bind_obs(disp.obs)
+        return disp
 
 
 def make_registry_sharded_serve_fn(

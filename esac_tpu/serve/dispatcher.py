@@ -60,6 +60,33 @@ logs) is a ring buffer sized by ``stats_window``; the per-lane
 ``dispatch_counts`` / outcome totals are keyed by (scene, route_k),
 bounded by the fleet, not by traffic — a week-long server's host memory
 stays flat (regression-pinned in tests/test_serve.py).
+
+Observability (DESIGN.md §14; esac_tpu.obs): every dispatcher publishes
+its accounting into a :class:`~esac_tpu.obs.MetricsRegistry` (``obs``
+attribute; pass one in to aggregate, default private) — offered/outcome
+counters, per-lane dispatch counters, and streaming-quantile latency
+histograms that replaced ``latency_quantiles()``'s per-call sort of the
+whole ``latencies_s`` deque.  ``dispatch_totals``/``slo_totals`` are
+thin views over those counters (updated in the same locked sections as
+the legacy attributes, so the accounting invariant is one truth).  With
+``trace=True`` every request additionally carries a
+:class:`~esac_tpu.obs.SpanChain` stamped at the existing choke points
+(admitted -> coalesced -> staged -> dispatched -> device -> sliced ->
+outcome); the stamps reuse timestamps the dispatch path already takes —
+zero added host syncs, zero jit surface — and per-stage durations land
+in the ``serve_stage_seconds`` histogram at ``_finish``.  The overhead
+is gated by ``python bench.py obs`` (.obs_overhead.json).  NOTE on
+sharing: give two dispatchers one registry only if you want
+fleet-AGGREGATED counters — ``slo_totals`` then spans both dispatchers
+while ``pending`` stays per-instance, so the per-dispatcher accounting
+invariant intentionally applies to private registries (the default).
+Two more shared-mode caveats: collector registration is last-wins (the
+snapshot's ``serve_*`` collector blocks come from the most recent
+dispatcher), and ``reset_stats`` subtracts only the CALLING
+dispatcher's contribution from the shared counters (the other's
+history survives) but clears the shared latency/stage histograms —
+as does ``serve.loadgen.run_open_loop``'s run-start reset of the
+shared lane-latency histogram.
 """
 
 from __future__ import annotations
@@ -68,6 +95,7 @@ import collections
 import threading
 import time
 
+from esac_tpu.obs import MetricsRegistry, SpanChain
 from esac_tpu.ransac.config import RansacConfig
 from esac_tpu.serve.batching import (
     pad_batch,
@@ -94,7 +122,7 @@ class _Request:
 
     __slots__ = ("frame", "scene", "route_k", "event", "result", "error",
                  "t_submit", "t_done", "deadline", "done", "outcome",
-                 "owner")
+                 "owner", "spans")
 
     def __init__(self, frame, t_submit, scene=None, route_k=None,
                  deadline=None, owner=None):
@@ -110,6 +138,7 @@ class _Request:
         self.done = False
         self.outcome = None       # served|shed|expired|degraded|failed
         self.owner = owner        # dispatcher, for timeout abandonment
+        self.spans = None         # obs.SpanChain when tracing is on
 
     def get(self, timeout: float | None = None):
         """Wait up to ``timeout`` seconds for the result; raises the
@@ -168,6 +197,8 @@ class MicroBatchDispatcher:
         clock=time.perf_counter,
         stats_window: int = 10_000,
         slo: SLOPolicy | None = None,
+        obs: MetricsRegistry | None = None,
+        trace: bool = False,
     ):
         if stats_window < 1:
             raise ValueError(f"stats_window {stats_window} < 1")
@@ -237,6 +268,54 @@ class MicroBatchDispatcher:
         self.outcome_log: collections.deque = collections.deque(
             maxlen=stats_window
         )
+        # Observability (DESIGN.md §14): the unified metrics registry this
+        # dispatcher publishes into.  The instruments are created once
+        # here and cached as handles — the hot path never takes the
+        # registry lock, only per-instrument locks, always nested INSIDE
+        # the dispatcher lock (acyclic order: registry -> dispatcher ->
+        # instrument; see esac_tpu/obs/metrics.py).  ``trace`` gates the
+        # per-request span chains; everything else is always on.
+        self.obs = obs if obs is not None else MetricsRegistry()
+        self._trace = bool(trace)
+        self._m_offered = self.obs.counter(
+            "serve_offered_total",
+            "requests ever offered (re-based by reset_stats)",
+        )
+        self._m_outcomes = self.obs.counter(
+            "serve_outcomes_total",
+            "terminal outcome classes; with pending they sum to offered",
+        )
+        self._m_dispatches = self.obs.counter(
+            "serve_dispatches_total",
+            "completed dispatches per (scene, route_k) lane",
+        )
+        # Two latency instruments on purpose: the FLEET histogram is one
+        # unlabeled child whose window is the most recent 10*stats_window
+        # samples GLOBALLY — the exact recent-window semantics of the
+        # latencies_s deque it replaced (per-lane windows alone would let
+        # an idle lane's stale samples dominate merged quantiles forever,
+        # review finding) — while the LANE histogram carries the
+        # per-(scene, route_k) breakdown the open-loop views read.
+        self._m_latency = self.obs.histogram(
+            "serve_request_latency_seconds",
+            "fleet-wide per-request completion latency (recent window)",
+            window=10 * stats_window,
+        )
+        self._m_lane_latency = self.obs.histogram(
+            "serve_lane_latency_seconds",
+            "per-request completion latency by (scene, route_k) lane",
+            window=10 * stats_window,
+        )
+        self._m_stage = self.obs.histogram(
+            "serve_stage_seconds",
+            "per-stage span durations of traced requests",
+            window=10 * stats_window,
+        )
+        self.obs.register_collector("serve_slo_totals", self.slo_totals)
+        self.obs.register_collector("serve_dispatch_totals",
+                                    self.dispatch_totals)
+        self.obs.register_collector("serve_quarantined_lanes",
+                                    self.quarantined_lanes)
         self._worker = None
         self._watchdog = None
         if start_worker:
@@ -293,6 +372,8 @@ class MicroBatchDispatcher:
         deadline = (t_submit + deadline_ms / 1e3
                     if deadline_ms is not None else None)
         req = _Request(frame, t_submit, scene, route_k, deadline, owner=self)
+        if self._trace:
+            req.spans = SpanChain("admitted", t_submit)
         lane = (scene, route_k)
         with self._work:
             if self._slo is None:
@@ -304,22 +385,18 @@ class MicroBatchDispatcher:
                     remaining = (None if deadline is None
                                  else deadline - self._clock())
                     if remaining is not None and remaining <= 0:
-                        self.offered += 1
-                        self.outcome_counts["expired"] += 1
-                        self.outcome_log.append(
-                            ("expired", scene, route_k, None)
-                        )
+                        self._count_offered()
+                        self._count_outcome("expired", scene, route_k, None)
                         raise DeadlineExceededError(
                             "deadline expired waiting for queue space"
                         )
                     self._space.wait(remaining)
             self._raise_if_unservable()
-            self.offered += 1
+            self._count_offered()
             if self._slo is not None:
                 why = self._admission_reject(lane, req, t_submit)
                 if why is not None:
-                    self.outcome_counts["shed"] += 1
-                    self.outcome_log.append(("shed", scene, route_k, None))
+                    self._count_outcome("shed", scene, route_k, None)
                     raise why
             q = self._pending.get(lane)
             if q is None:
@@ -408,9 +485,11 @@ class MicroBatchDispatcher:
             bounds += [t_submit + timeout] if timeout is not None else []
             req = _Request(frame, t_submit, scene, route_k,
                            min(bounds) if bounds else None, owner=self)
+            if self._trace:
+                req.spans = SpanChain("admitted", t_submit)
             with self._work:
                 self._raise_if_unservable()
-                self.offered += 1
+                self._count_offered()
                 # Same lock acquisition as the offered count: the request
                 # must never be observable in neither table (the invariant
                 # holds at every instant on the sync path too).
@@ -486,15 +565,12 @@ class MicroBatchDispatcher:
                     pick_bucket(n_valid, self._buckets), n_valid, scene,
                     route_k, [t_done - t_submit] * n_valid,
                 )
-                self.offered += n_valid
-                self.outcome_counts["served"] += n_valid
+                self._count_offered(n_valid)
                 # Bulk serves ride the per-request trail too: the ring and
                 # the counters must tell one story on a mixed-traffic
                 # server.
-                self.outcome_log.extend(
-                    ("served", scene, route_k, route_k)
-                    for _ in range(n_valid)
-                )
+                self._count_outcome("served", scene, route_k, route_k,
+                                    n=n_valid)
             results.extend(
                 jax.tree.map(lambda x: x[j], host) for j in range(n_valid)
             )
@@ -513,6 +589,39 @@ class MicroBatchDispatcher:
             return self._infer(tree)
         return self._infer(tree, scene)
 
+    def _count_offered(self, n: int = 1):
+        """Count ``n`` offered requests (lock held): legacy attribute and
+        obs counter move in the same critical section, so the two can
+        never tell different stories."""
+        self.offered += n
+        self._m_offered.inc(n)
+
+    def _count_outcome(self, outcome, scene, route_k, eff_k, n: int = 1):
+        """Count ``n`` requests into one terminal outcome class (lock
+        held): Counter + ring trail + obs counter, one choke point."""
+        self.outcome_counts[outcome] += n
+        self.outcome_log.extend(
+            (outcome, scene, route_k, eff_k) for _ in range(n)
+        )
+        self._m_outcomes.inc(n, outcome=outcome)
+
+    def _stamp(self, reqs, stage, t=None):
+        """Span-stamp every traced request in ``reqs`` — a no-op (one
+        attribute check) with tracing off.  Chains are only ever written
+        by the thread that currently owns the request/batch, so no lock
+        is involved.  Requests already resolved (abandoned by caller
+        timeout / watchdog while this dispatch was in flight) are
+        skipped best-effort; the unavoidable race remnant — a late stamp
+        landing after the terminal one — is made inert by the chain's
+        read-side truncation (obs.trace)."""
+        if not self._trace:
+            return
+        if t is None:
+            t = self._clock()
+        for r in reqs:
+            if r.spans is not None and not r.done:
+                r.spans.stamp(stage, t)
+
     def _record(self, bucket, n_valid, scene, route_k, latencies):
         """Append one dispatch to the bounded stat rings (lock held)."""
         self.dispatch_log.append((bucket, n_valid))
@@ -520,6 +629,10 @@ class MicroBatchDispatcher:
         self.route_log.append(route_k)
         self.dispatch_counts[(scene, route_k)] += 1
         self.latencies_s.extend(latencies)
+        self._m_dispatches.inc(scene=scene, route_k=route_k)
+        for lat in latencies:
+            self._m_latency.observe(lat)
+            self._m_lane_latency.observe(lat, scene=scene, route_k=route_k)
 
     def _finish(self, req: _Request, result=None, error=None,
                 outcome: str = "served", eff_k=None) -> bool:
@@ -535,8 +648,14 @@ class MicroBatchDispatcher:
         req.error = error
         req.outcome = outcome
         req.t_done = self._clock()
-        self.outcome_counts[outcome] += 1
-        self.outcome_log.append((outcome, req.scene, req.route_k, eff_k))
+        self._count_outcome(outcome, req.scene, req.route_k, eff_k)
+        if req.spans is not None:
+            # Terminal stamp at t_done: the chain's total now telescopes
+            # to the measured end-to-end latency, and each stage duration
+            # lands in the stage histogram.
+            req.spans.stamp(outcome, req.t_done)
+            for stage, dt in req.spans.durations().items():
+                self._m_stage.observe(dt, stage=stage)
         req.event.set()
         return True
 
@@ -699,6 +818,7 @@ class MicroBatchDispatcher:
         on the sync path); a dispatch whose generation was abandoned by
         the watchdog discards its late outcome entirely."""
         scene, route_k = lane
+        self._stamp(reqs, "coalesced")
         attempt = 0
         while True:
             with self._work:
@@ -720,6 +840,7 @@ class MicroBatchDispatcher:
                     jax.tree.map(lambda x, i=i: x[i], host)
                     for i in range(len(reqs))
                 ]
+                self._stamp(reqs, "sliced")
             except Exception as e:  # noqa: BLE001 — fan the failure out
                 attempt += 1
                 with self._work:
@@ -800,7 +921,10 @@ class MicroBatchDispatcher:
     def _dispatch(self, reqs: list[_Request], scene, route_k):
         """Pad, stage and execute one dispatch; returns the host-side
         result tree + timing.  No dispatcher state is touched here — the
-        caller owns locking and fan-out."""
+        caller owns locking and fan-out.  The span stamps reuse the
+        timeline the dispatch path already walks (device_put, the async
+        call, the block_until_ready the path ALWAYS performs) — tracing
+        adds clock reads, never a sync."""
         import jax
         import numpy as np
 
@@ -808,9 +932,13 @@ class MicroBatchDispatcher:
         padded, n_valid = pad_batch(
             stack_frames([r.frame for r in reqs]), bucket
         )
-        out = self._call(jax.device_put(padded), scene, route_k)
+        staged = jax.device_put(padded)
+        self._stamp(reqs, "staged")
+        out = self._call(staged, scene, route_k)
+        self._stamp(reqs, "dispatched")
         out = jax.block_until_ready(out)
         t_done = self._clock()
+        self._stamp(reqs, "device", t_done)
         host = jax.tree.map(np.asarray, out)
         return host, bucket, n_valid, t_done
 
@@ -906,39 +1034,50 @@ class MicroBatchDispatcher:
     # ---------------- stats / lifecycle ----------------
 
     def latency_quantiles(self, qs=(0.5, 0.99)) -> dict[float, float]:
-        """Per-request latency quantiles (seconds), nearest-rank."""
-        with self._lock:
-            lat = sorted(self.latencies_s)
-        if not lat:
-            return {q: float("nan") for q in qs}
-        return {q: lat[min(len(lat) - 1, round(q * (len(lat) - 1)))] for q in qs}
+        """Per-request latency quantiles (seconds) over the recent
+        window, read from the fleet obs streaming histogram in
+        O(buckets) — the former implementation sorted the whole
+        ``10*stats_window`` ``latencies_s`` deque under the dispatch
+        lock on EVERY call, an O(n log n) hazard on a serving thread.
+        The window is GLOBAL (most recent samples fleet-wide, matching
+        the deque it replaced), not per-lane.  Values are sketch
+        estimates within the histogram's pinned tolerance of exact
+        nearest-rank (tests/test_obs.py); NaN when no samples, exactly
+        as before."""
+        return {q: self._m_latency.quantile(q) for q in qs}
 
     def dispatch_totals(self) -> dict:
-        """Per-(scene, route_k) lifetime dispatch counts, snapshotted under
-        the lock — the accessor concurrent monitors must use (iterating
-        ``dispatch_counts`` raw while the worker appends is a torn read;
-        graft-lint R10 discipline applies to callers too)."""
+        """Per-(scene, route_k) lifetime dispatch counts — a thin view
+        over the obs ``serve_dispatches_total`` counter, snapshotted
+        under the dispatch lock so it is write-consistent (every writer
+        holds the lock; iterating ``dispatch_counts`` raw while the
+        worker appends is a torn read; graft-lint R10 discipline applies
+        to callers too)."""
         with self._lock:
-            return dict(self.dispatch_counts)
+            # Zero-valued children (a lane fully subtracted out by
+            # reset_stats) are dropped: the legacy Counter never held
+            # explicit zeros and the view's shape is pinned.
+            return {
+                (labels.get("scene"), labels.get("route_k")): int(v)
+                for labels, v in self._m_dispatches.items() if v
+            }
 
     def slo_totals(self) -> dict:
-        """Locked snapshot of the outcome accounting: ``offered``, one
-        count per outcome class, and what is still in flight/queued.  The
-        invariant — served + shed + expired + degraded + failed + pending
-        == offered — is pinned by tests/test_serve_slo.py.  (A request
-        abandoned by its caller stays physically queued until the next
-        watchdog sweep; those are already counted in their outcome class,
-        so only unresolved requests count as pending here.)"""
+        """Locked snapshot of the outcome accounting — a thin view over
+        the obs ``serve_offered_total``/``serve_outcomes_total`` counters
+        (updated in the same critical sections as the legacy attributes)
+        plus the live ``pending`` count.  The invariant — served + shed +
+        expired + degraded + failed + pending == offered — is pinned by
+        tests/test_serve_slo.py.  (A request abandoned by its caller
+        stays physically queued until the next watchdog sweep; those are
+        already counted in their outcome class, so only unresolved
+        requests count as pending here.)"""
         with self._lock:
-            return {
-                "offered": self.offered,
-                "served": self.outcome_counts["served"],
-                "shed": self.outcome_counts["shed"],
-                "expired": self.outcome_counts["expired"],
-                "degraded": self.outcome_counts["degraded"],
-                "failed": self.outcome_counts["failed"],
-                "pending": self._unresolved_count(),
-            }
+            out = {"offered": int(self._m_offered.total())}
+            for o in ("served", "shed", "expired", "degraded", "failed"):
+                out[o] = int(self._m_outcomes.get(outcome=o))
+            out["pending"] = self._unresolved_count()
+            return out
 
     def _unresolved_count(self) -> int:
         """Requests not yet in any outcome class (lock held): queued ones
@@ -973,6 +1112,27 @@ class MicroBatchDispatcher:
         that set offered to 0 would break the accounting invariant
         forever on a busy server."""
         with self._lock:
+            # The obs counter views re-base in the same critical section
+            # by SUBTRACTING this dispatcher's own contribution (exactly
+            # what the legacy books recorded): on a private registry
+            # that leaves offered == unresolved and outcomes zero; on a
+            # SHARED registry another dispatcher's history survives a
+            # local reset instead of being wiped (review finding).
+            # Histograms have no subtractable contribution — a local
+            # reset clears them, one more shared-registry caveat the
+            # class docstring states.
+            unresolved = self._unresolved_count()
+            self._m_offered.inc(-(self.offered - unresolved))
+            for o, n in self.outcome_counts.items():
+                if n:
+                    self._m_outcomes.inc(-n, outcome=o)
+            for (scene, route_k), n in self.dispatch_counts.items():
+                if n:
+                    self._m_dispatches.inc(-n, scene=scene,
+                                           route_k=route_k)
+            self._m_latency.reset()
+            self._m_lane_latency.reset()
+            self._m_stage.reset()
             self.latencies_s.clear()
             self.dispatch_log.clear()
             self.scene_log.clear()
@@ -980,7 +1140,7 @@ class MicroBatchDispatcher:
             self.dispatch_counts.clear()
             self.outcome_counts.clear()
             self.outcome_log.clear()
-            self.offered = self._unresolved_count()
+            self.offered = unresolved
 
     def cache_size(self) -> int | None:
         """Compiled-program count of the jitted entry point (None when the

@@ -1,0 +1,518 @@
+"""Observability-layer tests (ISSUE 10, DESIGN.md §14).
+
+The load-bearing claims:
+
+- the streaming-quantile histogram that replaced ``latency_quantiles``'s
+  sort-the-whole-deque reports p50/p99 within a PINNED tolerance of the
+  exact nearest-rank values, at fixed memory, over a recent window;
+- every traced request's span durations sum (math.fsum) to its measured
+  end-to-end latency, and tracing off leaves the request path unstamped;
+- the four legacy snapshot surfaces (``slo_totals``,
+  ``dispatch_totals``, ``SceneRegistry.health``,
+  ``DeviceWeightCache.stats``) keep their pre-refactor shapes while
+  being views over / collectors of the unified obs registry;
+- ``obs.snapshot()`` is ``json.dumps``-able, stays CONSISTENT
+  mid-traffic (outcome classes + pending sum to offered in every
+  concurrent read) and never blocks admission — even while a dispatch is
+  wedged.
+"""
+
+import json
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from esac_tpu.obs import (
+    OBS_SCHEMA,
+    MetricsRegistry,
+    SpanChain,
+    StreamingHistogram,
+    jsonable,
+    render_prometheus,
+)
+from esac_tpu.ransac import RansacConfig
+from esac_tpu.serve import (
+    FaultInjector,
+    MicroBatchDispatcher,
+    SLOPolicy,
+    run_open_loop,
+    uniform_arrivals,
+)
+
+CFG = RansacConfig(n_hyps=8, frame_buckets=(1, 4), serve_max_wait_ms=1.0)
+
+# The pinned histogram tolerance: bucket growth 1.07 bounds the relative
+# quantile error at sqrt(1.07)-1 ~= 3.4%; 5% leaves margin for the
+# nearest-rank discretization at small sample counts.
+QUANTILE_RTOL = 0.05
+
+
+def _echo(tree, scene=None, route_k=None):
+    return {"echo": tree["x"]}
+
+
+def _frame(v=0.0):
+    return {"x": np.full(2, v, np.float32)}
+
+
+def _exact_rank(sorted_xs, q):
+    return sorted_xs[min(len(sorted_xs) - 1, round(q * (len(sorted_xs) - 1)))]
+
+
+# ---------------- streaming histogram (satellite 1) ----------------
+
+def test_histogram_quantiles_within_pinned_tolerance():
+    import random
+
+    rng = random.Random(0)
+    h = StreamingHistogram(window=5000)
+    xs = [rng.lognormvariate(-5.0, 1.0) for _ in range(20_000)]
+    for x in xs:
+        h.observe(x)
+    recent = sorted(xs[-5000:])
+    for q in (0.5, 0.9, 0.99):
+        exact = _exact_rank(recent, q)
+        est = h.quantile(q)
+        assert abs(est - exact) / exact <= QUANTILE_RTOL, (q, exact, est)
+
+
+def test_histogram_window_tracks_recent_distribution():
+    import random
+
+    rng = random.Random(1)
+    h = StreamingHistogram(window=2000)
+    for _ in range(10_000):
+        h.observe(rng.lognormvariate(-6.0, 0.3))  # ~2.5ms scale
+    for _ in range(4000):  # > window: the old regime must rotate out
+        h.observe(rng.lognormvariate(-3.0, 0.3))  # ~50ms scale
+    p50 = h.quantile(0.5)
+    assert 0.02 < p50 < 0.12, p50  # the NEW scale, not the old one
+
+
+def test_histogram_fixed_memory_and_edges():
+    h = StreamingHistogram(window=100, epochs=4)
+    assert math.isnan(h.quantile(0.5))
+    for i in range(100_000):
+        h.observe(1e-3 * (1 + (i % 7)))
+    # memory: at most `epochs` bucket arrays, however many samples landed
+    assert len(h._counts) <= 4
+    # non-finite / non-positive samples clamp, never raise or corrupt
+    h.observe(float("nan"))
+    h.observe(-1.0)
+    h.observe(float("inf"))
+    assert h.quantile(0.5) > 0
+    s = h.summary()
+    assert s["count"] > 0 and s["p50"] == h.quantile(0.5)
+    # single-sample histogram reports the sample exactly (min/max clamp)
+    h2 = StreamingHistogram()
+    h2.observe(0.25)
+    assert h2.quantile(0.5) == pytest.approx(0.25)
+
+
+# ---------------- registry / instruments / export ----------------
+
+def test_registry_instruments_idempotent_and_kind_checked():
+    r = MetricsRegistry()
+    c = r.counter("x_total", "help")
+    assert r.counter("x_total") is c
+    with pytest.raises(ValueError):
+        r.gauge("x_total")
+    c.inc(2, lane="a")
+    c.inc(lane="b")
+    assert c.total() == 3
+    assert c.get(lane="a") == 2
+    c.rebase(7, lane="a")
+    assert c.get(lane="a") == 7
+    c.reset()
+    assert c.total() == 0
+    g = r.gauge("g")
+    g.set(1.5, k="v")
+    assert g.get(k="v") == 1.5
+    assert math.isnan(g.get(k="other"))
+
+
+def test_registry_adopting_shared_instruments():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    c = a.counter("shared_total")
+    b.register(c)
+    c.inc(5)
+    assert b.get("shared_total").total() == 5
+    b.register(c)  # re-adopt: no-op
+    with pytest.raises(ValueError):
+        b.register(MetricsRegistry().counter("shared_total"))
+
+
+def test_snapshot_json_dumpable_with_hostile_collectors():
+    import collections
+
+    r = MetricsRegistry()
+    r.counter("c_total").inc(scene=None, route_k=2)
+    r.histogram("h_seconds", window=10).observe(0.01, stage="device")
+    r.register_collector("tuple_keys", lambda: {("s0", None): 1})
+    r.register_collector("numpyish", lambda: {"v": np.float32(1.5),
+                                              "n": np.int64(3)})
+    r.register_collector("dequeish",
+                         lambda: collections.deque([1, 2], maxlen=4))
+    r.register_collector("sick", lambda: 1 / 0)
+    snap = r.snapshot()
+    text = json.dumps(snap)  # the contract: NEVER raises
+    assert snap["obs_schema"] == OBS_SCHEMA
+    assert snap["collectors"]["tuple_keys"] == {"('s0', None)": 1}
+    assert snap["collectors"]["numpyish"] == {"v": 1.5, "n": 3}
+    assert snap["collectors"]["dequeish"] == [1, 2]
+    assert "ZeroDivisionError" in snap["collectors"]["sick"]["error"]
+    assert "c_total" in text and "h_seconds" in text
+
+
+def test_render_prometheus_format():
+    r = MetricsRegistry()
+    r.counter("req_total", "requests").inc(3, scene="s0")
+    r.histogram("lat_seconds", window=10).observe(0.25)
+    page = r.render_prometheus()
+    assert "# TYPE req_total counter" in page
+    assert 'req_total{scene="s0"} 3.0' in page
+    assert "# TYPE lat_seconds summary" in page
+    assert 'lat_seconds{quantile="0.5"}' in page
+    assert "lat_seconds_count" in page
+
+
+def test_jsonable_stringifies_odd_keys_and_leaves():
+    out = jsonable({(1, None): {np.float64(2.0), "x"}, "a": (1, 2)})
+    json.dumps(out)
+    assert out["(1, None)"] is not None and out["a"] == [1, 2]
+
+
+# ---------------- span chains ----------------
+
+def test_span_chain_durations_telescope():
+    ch = SpanChain("admitted", 10.0)
+    ch.stamp("coalesced", 10.5)
+    ch.stamp("staged", 10.6)
+    ch.stamp("staged", 10.9)  # retry re-stamp: aggregation must survive
+    ch.stamp("served", 11.25)
+    d = ch.durations()
+    assert d["staged"] == pytest.approx(0.4)
+    assert math.fsum(d.values()) == pytest.approx(ch.total())
+    assert ch.residual() < 1e-12
+    assert ch.total() == pytest.approx(1.25)
+
+
+def test_traced_dispatcher_spans_sum_to_measured_latency():
+    disp = MicroBatchDispatcher(_echo, CFG, trace=True)
+    try:
+        reqs = [disp.submit(_frame(i), scene=f"s{i % 2}") for i in range(8)]
+        for r in reqs:
+            r.get(60.0)
+        for r in reqs:
+            stages = [s for s, _ in r.spans.stamps]
+            assert stages[0] == "admitted" and stages[-1] == "served"
+            assert {"coalesced", "staged", "dispatched", "device",
+                    "sliced"} <= set(stages)
+            # the acceptance pin: per-stage durations sum EXACTLY (fsum)
+            # to the measured end-to-end latency
+            resid = abs(math.fsum(r.spans.durations().values())
+                        - (r.t_done - r.t_submit))
+            assert resid < 1e-9, (stages, resid)
+        stage_hist = disp.obs.get("serve_stage_seconds")
+        for stage in ("coalesced", "staged", "dispatched", "device",
+                      "sliced", "served"):
+            assert stage_hist.count(stage=stage) == len(reqs), stage
+    finally:
+        disp.close()
+
+
+def test_tracing_off_leaves_requests_unstamped_but_metrics_on():
+    disp = MicroBatchDispatcher(_echo, CFG)
+    try:
+        req = disp.submit(_frame(1.0))
+        req.get(60.0)
+        assert req.spans is None
+        assert disp.obs.get("serve_stage_seconds").count() == 0
+        assert disp.obs.get("serve_offered_total").total() == 1
+        assert disp.obs.get("serve_outcomes_total").get(outcome="served") == 1
+    finally:
+        disp.close()
+
+
+# ---------------- legacy snapshot surfaces: exact-compat pins ----------
+
+def test_slo_and_dispatch_views_match_legacy_attributes():
+    disp = MicroBatchDispatcher(_echo, CFG, start_worker=False)
+    for i in range(60):
+        disp.infer_one(_frame(i), scene=f"s{i % 3}",
+                       route_k=(i % 2) or None)
+    t = disp.slo_totals()
+    assert set(t) == {"offered", "served", "shed", "expired", "degraded",
+                      "failed", "pending"}
+    assert all(isinstance(v, int) for v in t.values())
+    # the view and the legacy attributes tell ONE story
+    assert t["offered"] == disp.offered == 60
+    assert t["served"] == disp.outcome_counts["served"] == 60
+    totals = disp.dispatch_totals()
+    assert totals == dict(disp.dispatch_counts)
+    assert all(isinstance(k, tuple) and len(k) == 2 for k in totals)
+    # satellite 1: the histogram-backed quantiles stay within the pinned
+    # tolerance of exact nearest-rank over the SAME window
+    lat = sorted(disp.latencies_s)
+    q = disp.latency_quantiles()
+    assert set(q) == {0.5, 0.99}
+    for p, est in q.items():
+        exact = _exact_rank(lat, p)
+        assert abs(est - exact) / exact <= QUANTILE_RTOL, (p, exact, est)
+
+
+def test_reset_stats_rebases_obs_views_too():
+    disp = MicroBatchDispatcher(_echo, CFG, start_worker=False)
+    for i in range(5):
+        disp.infer_one(_frame(i), scene="s")
+    disp.reset_stats()
+    t = disp.slo_totals()
+    assert t["offered"] == 0 and t["served"] == 0 and t["pending"] == 0
+    assert disp.dispatch_totals() == {}
+    assert math.isnan(disp.latency_quantiles()[0.5])
+    disp.infer_one(_frame(9), scene="s")
+    t = disp.slo_totals()
+    assert t["offered"] == t["served"] == 1
+
+
+def test_cache_stats_and_registry_health_shapes_pinned():
+    from esac_tpu.registry import (
+        DeviceWeightCache, SceneManifest, SceneRegistry,
+    )
+
+    cache = DeviceWeightCache(lambda e: {})
+    assert set(cache.stats()) == {
+        "hits", "misses", "evictions", "resident", "bytes_in_use",
+        "budget_bytes", "load_failures", "loads_in_flight",
+    }
+    reg = SceneRegistry(SceneManifest())
+    h = reg.health()
+    assert set(h) == {"scenes", "canaries", "events"}
+    assert h["scenes"] == {} and h["canaries"] == {} and h["events"] == []
+    json.dumps(h)
+
+
+def test_scene_registry_binds_into_dispatcher_obs():
+    from esac_tpu.registry import SceneManifest, SceneRegistry
+
+    reg = SceneRegistry(SceneManifest())
+    disp = reg.dispatcher(CFG, start_worker=False)
+    snap = disp.obs.snapshot()
+    assert {"serve_slo_totals", "serve_dispatch_totals",
+            "serve_quarantined_lanes", "scene_health",
+            "weight_cache"} <= set(snap["collectors"])
+    # shared instrument OBJECTS, not copies: one fleet truth
+    assert disp.obs.get("registry_health_events_total") \
+        is reg.obs.get("registry_health_events_total")
+    assert snap["collectors"]["scene_health"]["scenes"] == {}
+    assert snap["collectors"]["weight_cache"]["resident"] == 0
+    json.dumps(snap)
+    # a second dispatcher over the same registry adopts the same
+    # instruments without error, but keeps PRIVATE serve accounting
+    disp2 = reg.dispatcher(CFG, start_worker=False)
+    assert disp2.obs is not disp.obs
+    assert disp2.obs.get("registry_health_events_total") \
+        is reg.obs.get("registry_health_events_total")
+
+
+# ---------------- open-loop per-lane views (satellite 2) --------------
+
+def test_run_open_loop_reports_per_scene_and_per_route_quantiles():
+    disp = MicroBatchDispatcher(_echo, CFG,
+                                slo=SLOPolicy(deadline_ms=30_000.0))
+    try:
+        # Warmup on a DIFFERENT lane: the run-local blocks must cover
+        # exactly the run (lane histogram reset at run start) and a
+        # stale pre-run lane must not appear as a count-0 NaN row.
+        disp.infer_one(_frame(0), scene="warm", timeout=30.0)
+        res = run_open_loop(
+            disp,
+            lambda i: (_frame(i), f"s{i % 2}", None),
+            uniform_arrivals(300.0, 40),
+            deadline_ms=30_000.0,
+        )
+    finally:
+        disp.close()
+    assert res["outcomes"]["served"] + res["outcomes"]["degraded"] == 40
+    assert set(res["per_scene"]) == {"s0", "s1"}
+    for rec in res["per_scene"].values():
+        assert rec["count"] == 20
+        assert rec["p50_ms"] > 0 and rec["p99_ms"] >= rec["p50_ms"] * 0.9
+    assert set(res["per_route_k"]) == {"None"}
+    assert res["per_route_k"]["None"]["count"] == 40
+    json.dumps(res["per_scene"])
+
+
+def test_abandoned_request_span_survives_late_worker_stamps():
+    """Review regression: a request abandoned mid-dispatch (caller
+    timeout while the worker is wedged) gets its terminal stamp from
+    `_abandon`; when the worker unsticks, its late stage stamps must be
+    INERT — the chain still reads stamps-to-terminal only, and the
+    telescoping sum still equals the measured end-to-end latency."""
+    from esac_tpu.serve import DeadlineExceededError
+
+    inj = FaultInjector(_echo)
+    release = threading.Event()
+    slo = SLOPolicy(deadline_ms=60_000.0, watchdog_ms=60_000.0)
+    disp = MicroBatchDispatcher(inj, CFG, slo=slo, trace=True)
+    try:
+        inj.stall_once(release)
+        req = disp.submit(_frame(1.0), scene="s")
+        with pytest.raises(DeadlineExceededError):
+            req.get(0.3)  # abandon while the dispatch is wedged
+        assert req.outcome == "expired"
+        release.set()  # the worker unsticks and stamps late
+        deadline = time.time() + 10
+        while disp.slo_totals()["pending"] and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.05)  # let any late stamp land
+        eff_stages = [s for s, _ in req.spans._effective()]
+        assert eff_stages[-1] == "expired"
+        assert req.spans.total() == pytest.approx(req.t_done - req.t_submit)
+        resid = abs(math.fsum(req.spans.durations().values())
+                    - (req.t_done - req.t_submit))
+        assert resid < 1e-9
+    finally:
+        release.set()
+        disp.close()
+
+
+def test_reset_stats_on_shared_registry_preserves_other_dispatcher():
+    """Review regression: on a SHARED obs registry, one dispatcher's
+    reset_stats must subtract only its OWN contribution — the other
+    dispatcher's accounting invariant survives."""
+    shared = MetricsRegistry()
+    a = MicroBatchDispatcher(_echo, CFG, start_worker=False, obs=shared)
+    b = MicroBatchDispatcher(_echo, CFG, start_worker=False, obs=shared)
+    for i in range(4):
+        a.infer_one(_frame(i), scene="sa")
+    for i in range(6):
+        b.infer_one(_frame(i), scene="sb")
+    assert shared.get("serve_offered_total").total() == 10
+    a.reset_stats()
+    # b's history survives in the shared counters; a's is gone
+    assert shared.get("serve_offered_total").total() == 6
+    tb = b.slo_totals()
+    assert tb["offered"] == 6 and tb["served"] == 6 and tb["pending"] == 0
+    ta = a.slo_totals()
+    # a's view now spans the shared registry (the documented aggregation
+    # semantics) but must not have gone negative or inconsistent
+    assert ta["offered"] == 6 and ta["served"] == 6
+    assert b.dispatch_totals() == {("sb", None): 6}
+
+
+# ---------------- dump CLI ----------------
+
+def test_obs_cli_renders_artifact_and_bare_snapshots(tmp_path, capsys):
+    from esac_tpu.obs.__main__ import main as obs_main
+
+    r = MetricsRegistry()
+    r.counter("req_total", "requests").inc(2, scene="s0")
+    snap = r.snapshot()
+
+    artifact = tmp_path / "artifact.json"
+    artifact.write_text(json.dumps(
+        {"metric": "x", "obs_provenance": {"obs_schema": OBS_SCHEMA,
+                                           "fleet": snap}}
+    ))
+    assert obs_main(["--file", str(artifact)]) == 0
+    page = capsys.readouterr().out
+    assert "# TYPE req_total counter" in page
+
+    bare = tmp_path / "snap.json"
+    bare.write_text(json.dumps(snap))
+    assert obs_main(["--file", str(bare), "--format", "json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["obs_schema"] == OBS_SCHEMA
+
+    assert obs_main(["--file", str(tmp_path / "missing.json")]) == 2
+    empty = tmp_path / "empty.json"
+    empty.write_text("{}")
+    assert obs_main(["--file", str(empty)]) == 2
+
+
+# ---------------- concurrency: consistent, non-blocking snapshots -----
+
+def test_concurrent_snapshots_consistent_and_admission_unblocked():
+    """The R10 stress leg of the obs layer: serving threads race
+    snapshot/export readers; every mid-traffic snapshot's outcome
+    classes + pending must sum EXACTLY to offered, and the export
+    surface must never corrupt or raise."""
+    cfg = RansacConfig(n_hyps=8, frame_buckets=(1, 4),
+                       serve_max_wait_ms=1.0, serve_queue_depth=64)
+    disp = MicroBatchDispatcher(_echo, cfg, trace=True,
+                                slo=SLOPolicy(deadline_ms=60_000.0))
+    n_callers, n_each = 3, 40
+    errors: list[Exception] = []
+    done = threading.Event()
+
+    def caller(tid):
+        try:
+            for i in range(n_each):
+                out = disp.infer_one(_frame(tid * 1000 + i),
+                                     scene=f"s{tid}", timeout=60.0)
+                assert float(out["echo"][0]) == tid * 1000 + i
+        except Exception as e:  # noqa: BLE001 — surfaced in main thread
+            errors.append(e)
+
+    def reader():
+        try:
+            while not done.is_set():
+                snap = disp.obs.snapshot()
+                t = snap["collectors"]["serve_slo_totals"]
+                total = (t["served"] + t["shed"] + t["expired"]
+                         + t["degraded"] + t["failed"] + t["pending"])
+                assert total == t["offered"], t
+                assert "# TYPE" in render_prometheus(snap)
+                json.dumps(snap)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    callers = [threading.Thread(target=caller, args=(t,))
+               for t in range(n_callers)]
+    readers = [threading.Thread(target=reader) for _ in range(2)]
+    for t in callers + readers:
+        t.start()
+    for t in callers:
+        t.join(60)
+    done.set()
+    for t in readers:
+        t.join(10)
+    disp.close()
+    assert errors == [], errors
+    t = disp.slo_totals()
+    assert t["served"] == n_callers * n_each == t["offered"]
+
+
+def test_snapshot_and_admission_never_block_on_wedged_dispatch():
+    """A wedged in-flight dispatch (the observed relay-stall mode) must
+    not make observability part of the outage: snapshot/export return
+    promptly and submits still admit while the worker is stuck."""
+    inj = FaultInjector(_echo)
+    release = threading.Event()
+    slo = SLOPolicy(deadline_ms=60_000.0, watchdog_ms=60_000.0)
+    disp = MicroBatchDispatcher(inj, CFG, slo=slo, trace=True)
+    try:
+        inj.stall_once(release)
+        wedged = disp.submit(_frame(1.0), scene="bad")
+        deadline = time.time() + 10
+        while disp.slo_totals()["pending"] < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        t0 = time.perf_counter()
+        snap = disp.obs.snapshot()
+        dt_snap = time.perf_counter() - t0
+        assert dt_snap < 2.0, dt_snap
+        t = snap["collectors"]["serve_slo_totals"]
+        assert t["pending"] >= 1 and t["offered"] >= 1
+        t0 = time.perf_counter()
+        queued = disp.submit(_frame(2.0), scene="good")
+        assert time.perf_counter() - t0 < 0.5  # admission not blocked
+        release.set()
+        queued.get(60.0)
+        wedged.get(60.0)
+    finally:
+        release.set()
+        disp.close()
