@@ -1072,6 +1072,16 @@ def _measure_chaos_at(root: pathlib.Path, seconds: float) -> dict:
         health=HealthPolicy(window=16, min_samples=4, trip_bad_frac=0.5,
                             canary_min_samples=8),
     )
+    # graft-audit v3 runtime lock witness (lint/witness.py): the chaos
+    # drill is the one leg that exercises the registry-side lock nest
+    # (health -> manifest on rollback, health -> counter on events,
+    # cache under fault load) — attach BEFORE any traffic so the drill's
+    # actual acquisition edges land in the artifact and are checked
+    # against the committed .lock_graph.json partial order.
+    from esac_tpu.lint.witness import LockWitness
+
+    witness = LockWitness()
+    witness.attach_fleet(registry=registry, injector=inj)
 
     def frame(i):
         return {
@@ -1100,7 +1110,12 @@ def _measure_chaos_at(root: pathlib.Path, seconds: float) -> dict:
                     watchdog_ms=max(10_000.0, 50 * dispatch_s * 1e3),
                     retry_max=1, quarantine_after=2)
 
-    disp = registry.dispatcher(cfg, slo=slo)
+    # Witness contract: attach before the worker starts (a thread
+    # waiting on the pre-wrap lock object would never see a notify on
+    # the rebuilt condition).
+    disp = registry.dispatcher(cfg, slo=slo, start_worker=False)
+    witness.attach_fleet(disp=disp)
+    disp.start()
     for i, s in enumerate(scenes):
         disp.infer_one(pool[i], scene=s, deadline_ms=60_000.0)
 
@@ -1221,7 +1236,37 @@ def _measure_chaos_at(root: pathlib.Path, seconds: float) -> dict:
     compiled_after = registry.compile_cache_size()
     disp.close()
 
+    # graft-audit v3: the drill's OBSERVED lock-acquisition edges vs the
+    # committed static order — the runtime half of R12.  Violations ride
+    # the artifact typed (the drill is a measurement, not a test; the
+    # tier-1 stress legs are where the same check asserts).
+    from esac_tpu.lint.lockgraph import LOCK_GRAPH_NAME, load_graph
+
+    committed_graph = load_graph(_REPO / LOCK_GRAPH_NAME)
+    witness_snap = witness.snapshot()
+    violations = (witness.violations(committed_graph)
+                  if committed_graph is not None else None)
+    lock_witness = {
+        "edges_observed": witness_snap["edges"],
+        "committed_graph_present": committed_graph is not None,
+        "violations": violations,
+        "observed_subgraph_of_committed": (
+            violations == [] if violations is not None else None
+        ),
+        # Hold-time evidence for the fleet's critical sections (bounded:
+        # the sketches are fixed-memory streaming histograms).
+        "hold_seconds": witness_snap["holds"],
+        # Worst blocked-while-held events (acquires that waited while
+        # the thread already held another witnessed lock) — the runtime
+        # shadow of R13, expected rare and short.
+        "blocked_while_held_worst": sorted(
+            witness_snap["blocked_while_held"],
+            key=lambda e: -e["waited_s"],
+        )[:10],
+    }
+
     return {
+        "lock_witness": lock_witness,
         "scenes": {"n": len(scenes), "hw": [H, W], "num_experts": M,
                    "n_hyps": CHAOS_HYPS, "frame_bucket": CHAOS_BUCKET},
         "closed_loop_dispatch_ms": round(dispatch_s * 1e3, 2),

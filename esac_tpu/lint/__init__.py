@@ -31,6 +31,7 @@ findings.  See LINT.md for the rule catalog and workflow.
 from esac_tpu.lint.findings import Finding, RULES
 from esac_tpu.lint.ast_rules import run_python_rules, run_registry_coverage
 from esac_tpu.lint.concurrency import run_concurrency_rules
+from esac_tpu.lint.lockgraph import run_lock_rules
 from esac_tpu.lint.shell_rules import run_shell_rules
 from esac_tpu.lint.suppress import Baseline, filter_suppressed
 
@@ -40,6 +41,7 @@ __all__ = [
     "run_python_rules",
     "run_shell_rules",
     "run_concurrency_rules",
+    "run_lock_rules",
     "run_registry_coverage",
     "Baseline",
     "filter_suppressed",
@@ -50,10 +52,16 @@ __all__ = [
 def run_layer1(root, files=None):
     """All layer-1 findings for the tree at ``root`` (inline suppressions
     already applied, baseline NOT applied — callers decide).  Includes the
-    serve-layer concurrency rules (R10) and the registry coverage gate
-    (R11, tree-global whenever package files are in scope)."""
+    serve-layer concurrency rules (R10), the registry coverage gate
+    (R11, tree-global whenever package files are in scope), and the
+    graft-audit v3 fleet concurrency analysis (R12 lock-order cycles /
+    self-deadlocks + R13 blocking-under-lock; the committed
+    .lock_graph.json DIFF gate rides the CLI, ledger-style).  The lock
+    pass is fleet-global but skipped when a scoped run touched no
+    serve/registry/obs/lint file (--changed fast mode)."""
     findings = run_python_rules(root, files=files)
     findings += run_shell_rules(root, files=files)
     findings += run_concurrency_rules(root, files=files)
+    findings += run_lock_rules(root, files=files)
     findings += run_registry_coverage(root, files=files)
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

@@ -927,9 +927,28 @@ def run_registry_coverage(root, files=None) -> list[Finding]:
             "compiled surface must ride the jaxpr audit + resource ledger "
             "(add a registry Entry, or a waiver with a reviewed reason)",
         )
-        if not is_suppressed("R11", lineno, per_line, per_file):
+        if not is_suppressed("R11", lineno, per_line, per_file, path=rel):
             findings.append(f)
     return findings
+
+
+def stale_r11_waivers(root) -> list[str]:
+    """Notes for R11_WAIVED entries naming no discovered entry point —
+    the waived function was removed or renamed, so the waiver is a
+    dangling reviewed-exception that would silently cover a FUTURE
+    function reusing the name (graft-audit v3 stale-suppression sweep)."""
+    root = pathlib.Path(root)
+    registry_path = root / "esac_tpu" / "lint" / "registry.py"
+    if not registry_path.exists():
+        return []
+    _, waived = _r11_registry_names(registry_path.read_text())
+    discovered = {name for _, _, name in _r11_discover(root)}
+    return [
+        f"stale R11 waiver '{name}': no public jitted entry point of "
+        "that name is discovered any more — prune it from R11_WAIVED "
+        "(esac_tpu/lint/registry.py)"
+        for name in sorted(waived) if name not in discovered
+    ]
 
 
 # --------------------------------------------------------------------------
@@ -981,6 +1000,7 @@ def run_python_rules(root, files=None) -> list[Finding]:
     out = []
     for f in findings:
         per_line, per_file = suppressions.get(f.path, ({}, set()))
-        if not is_suppressed(f.rule, f.line, per_line, per_file):
+        if not is_suppressed(f.rule, f.line, per_line, per_file,
+                             path=f.path):
             out.append(f)
     return out

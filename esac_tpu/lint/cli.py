@@ -5,12 +5,17 @@ error.
 
 Modes
 -----
-- default           : layer 1 over the full tree + layer 2 (jaxpr audit +
-                      resource-ledger diff vs the committed
-                      .jaxpr_ledger.json)
+- default           : layer 1 over the full tree (incl. the graft-audit v3
+                      R12/R13 fleet concurrency analysis + the lock-graph
+                      diff vs the committed .lock_graph.json) + layer 2
+                      (jaxpr audit + resource-ledger diff vs the committed
+                      .jaxpr_ledger.json); full-tree runs also sweep for
+                      stale inline suppressions and stale R11 waivers
 - ``--changed``     : layer 1 over git-modified/untracked files only; the
                       jaxpr audit AND the ledger run only when a traced
-                      package file changed (fast pre-commit mode)
+                      package file changed, and the lock-graph pass only
+                      when a serve/registry/obs/lint file changed (fast
+                      pre-commit mode)
 - ``PATHS…``        : layer 1 over the given files/dirs; layer 2 only when
                       they include package (esac_tpu/) files
 - ``--no-jaxpr``    : skip layer 2 (audit + ledger) anywhere
@@ -21,6 +26,9 @@ Modes
                       layer-1 findings (review the diff before committing!)
 - ``--write-ledger``: regenerate .jaxpr_ledger.json from the current
                       registry traces (review the diff before committing!)
+- ``--write-lock-graph``: regenerate .lock_graph.json from the current
+                      fleet lock analysis (review the edges before
+                      committing!)
 
 The jaxpr audit itself forces the CPU backend before any device use — the
 lint must never become the second stuck TPU client it lints against
@@ -35,8 +43,14 @@ import subprocess
 import sys
 
 from esac_tpu.lint import run_layer1
+from esac_tpu.lint import lockgraph
 from esac_tpu.lint.findings import RULES, Finding
-from esac_tpu.lint.suppress import Baseline
+from esac_tpu.lint.suppress import (
+    Baseline,
+    declared_suppressions,
+    record_usage,
+    stale_suppressions,
+)
 
 BASELINE_NAME = "lint_baseline.json"
 
@@ -118,6 +132,9 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--write-ledger", action="store_true",
                         help="regenerate .jaxpr_ledger.json from the "
                              "current registry traces")
+    parser.add_argument("--write-lock-graph", action="store_true",
+                        help="regenerate .lock_graph.json from the "
+                             "current fleet lock analysis")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
@@ -140,6 +157,21 @@ def main(argv: list[str] | None = None) -> int:
             print(f.to_json(ordinal))
         else:
             print(f.format())
+
+    if args.write_lock_graph:
+        try:
+            graph = lockgraph.build_graph(root)
+            lockgraph.write_graph(root / lockgraph.LOCK_GRAPH_NAME, graph)
+        except Exception as e:
+            _note(f"graft-lint: internal error writing lock graph: {e!r}")
+            return 2
+        _note(
+            f"graft-lint: wrote {len(graph['nodes'])} lock node(s) / "
+            f"{len(graph['edges'])} edge(s) to "
+            f"{root / lockgraph.LOCK_GRAPH_NAME} — review the diff before "
+            "committing"
+        )
+        return 0
 
     if args.write_ledger:
         if args.no_jaxpr:
@@ -173,7 +205,8 @@ def main(argv: list[str] | None = None) -> int:
         elif args.paths:
             files = _expand_paths(root, args.paths)
 
-        findings = run_layer1(root, files=files)
+        with record_usage() as used_suppressions:
+            findings = run_layer1(root, files=files)
 
         if args.write_baseline:
             if files is not None:
@@ -197,6 +230,11 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     # In scoped runs most baseline entries legitimately match nothing
     # (their files weren't linted) — only report staleness on full runs.
+    # The same logic governs the suppression sweep: only a full run sees
+    # every finding a directive could mask, so only a full run may call
+    # one stale (graft-audit v3; tests/ and esac_tpu/lint/ are excluded —
+    # fixture source strings and the lint's own docstrings contain
+    # directive-SHAPED text that is documentation, not directives).
     if files is None:
         for e in stale:
             _note(
@@ -204,9 +242,57 @@ def main(argv: list[str] | None = None) -> int:
                 "expired or no longer matches — remove it from "
                 f"{baseline_path.name}"
             )
+        try:
+            declared = {
+                d for d in declared_suppressions(root)
+                if not d[0].startswith(("tests/", "esac_tpu/lint/"))
+            }
+            for note in stale_suppressions(declared, used_suppressions):
+                _note(f"graft-lint: {note}")
+            from esac_tpu.lint.ast_rules import stale_r11_waivers
+
+            for note in stale_r11_waivers(root):
+                _note(f"graft-lint: {note}")
+        except Exception as e:  # notes only — never block the verdict
+            _note(f"graft-lint: suppression sweep failed: {e!r}")
 
     for f in findings:
         emit(f)
+
+    # Lock-graph diff gate (graft-audit v3, ledger pattern): the R12/R13
+    # analysis findings already rode run_layer1; here the CURRENT edge
+    # set is held to the committed .lock_graph.json — an unreviewed new
+    # edge fails, drift reports stale.  Only audited trees (those with a
+    # lint registry) carry the artifact.
+    lock_findings: list[Finding] = []
+    lock_ran = False
+    if lockgraph.lock_pass_needed(files) and \
+            (root / "esac_tpu" / "lint" / "registry.py").exists():
+        try:
+            current_graph = lockgraph.build_graph(root)
+            lock_ran = True
+            committed_graph = lockgraph.load_graph(
+                root / lockgraph.LOCK_GRAPH_NAME
+            )
+            if committed_graph is None:
+                lock_findings = [Finding(
+                    "R12", lockgraph.LOCK_GRAPH_NAME, 0,
+                    "missing-lock-graph",
+                    "no committed lock-order graph; run "
+                    "`python -m esac_tpu.lint --write-lock-graph`, review "
+                    "the edges, and commit the file",
+                )]
+            else:
+                lock_findings, lock_stale = lockgraph.diff_graph(
+                    committed_graph, current_graph
+                )
+                for note in lock_stale:
+                    _note(f"graft-lint: {note}")
+        except Exception as e:
+            _note(f"graft-lint: internal error in lock-graph gate: {e!r}")
+            return 2
+        for f in lock_findings:
+            emit(f)
 
     audit_failures: list[Finding] = []
     ledger_findings: list[Finding] = []
@@ -238,11 +324,16 @@ def main(argv: list[str] | None = None) -> int:
         for f in audit_failures + ledger_findings:
             emit(f)
 
-    n = len(findings) + len(audit_failures) + len(ledger_findings)
+    n = (len(findings) + len(lock_findings) + len(audit_failures)
+         + len(ledger_findings))
     scope = "changed files" if args.changed else ("paths" if args.paths else "tree")
+    extras = []
+    if lock_ran:
+        extras.append("lock graph")
+    if not args.no_jaxpr and _audit_needed(files):
+        extras.append("jaxpr audit + ledger")
     summary = (f"graft-lint: {n} finding(s) over {scope}"
-               + ("" if args.no_jaxpr or not _audit_needed(files)
-                  else " (incl. jaxpr audit + ledger)"))
+               + (f" (incl. {', '.join(extras)})" if extras else ""))
     if args.format == "json":
         _note(summary)
     else:

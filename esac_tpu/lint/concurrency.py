@@ -251,7 +251,8 @@ def _analyze_class(rel, cls: ast.ClassDef, lines, per_line, per_file):
                 "to lock-protected mutable state must hold the lock "
                 "(serve-layer concurrency invariant)",
             )
-            if not is_suppressed("R10", a.lineno, per_line, per_file):
+            if not is_suppressed("R10", a.lineno, per_line, per_file,
+                                 path=rel):
                 out.append(f)
     return out
 

@@ -135,6 +135,25 @@ RULES = {
         "coverage gate that keeps the entry-point matrix inside the audit "
         "(ROADMAP item 5 precondition)",
     ),
+    "R12": (
+        "lock-order hazard: cycle, self-deadlock, or an edge not in the "
+        "committed .lock_graph.json",
+        "LINT.md graft-audit v3 / DESIGN.md §15: the fleet's lock "
+        "acquisition order (dispatcher -> obs instruments, registry "
+        "health -> manifest, …) is a committed partial order — a cycle "
+        "deadlocks, a re-acquired non-reentrant lock self-deadlocks, and "
+        "a new edge needs review (--write-lock-graph + commit the diff)",
+    ),
+    "R13": (
+        "blocking or unbounded-time call while a lock is held",
+        "LINT.md graft-audit v3: Event/Condition waits, joins, sleeps, "
+        "file/checkpoint IO and jax device syncs under a lock stall every "
+        "thread needing it (the wedge class the SLO layer exists to "
+        "bound) — snapshot under the lock, block outside (the "
+        "_drain_probes / per-key cache-load-future pattern); the "
+        "coalescing Condition.wait that RELEASES the held lock is the "
+        "one allowlisted idiom",
+    ),
     # Layer-2 (jaxpr auditor) finding ids, reported with path = the
     # registry entry name:
     "J1": (

@@ -88,6 +88,7 @@ def run_shell_rules(root, files=None) -> list[Finding]:
         per_line, per_file = parse_suppressions(source)
         for lineno, line in enumerate(source.splitlines(), start=1):
             for f in _scan_line(rel, lineno, line):
-                if not is_suppressed(f.rule, f.line, per_line, per_file):
+                if not is_suppressed(f.rule, f.line, per_line, per_file,
+                                     path=rel):
                     findings.append(f)
     return findings

@@ -14,10 +14,19 @@ Two escape hatches, with different intents:
   independent — and may carry an ``expires: "YYYY-MM-DD"`` date after which
   they stop masking.  New code should never add baseline entries; fix or
   inline-suppress instead.
+
+Both hatches can go stale — the code they excused gets fixed or deleted
+while the directive lingers, silently ready to mask a FUTURE violation.
+Full-tree runs therefore audit them: :func:`record_usage` collects which
+directives actually masked a finding during a run, and
+:func:`stale_suppressions` diffs that against every directive declared in
+the tree (graft-audit v3; the CLI reports the leftovers so they get
+pruned, the exact sweep the baseline already has).
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import datetime
 import json
@@ -85,13 +94,82 @@ def parse_suppressions(source: str) -> tuple[dict[int, set[str]], set[str]]:
     return per_line, per_file
 
 
+# Active usage recorder (None = off).  A set of (path, lineno, rule)
+# triples — lineno 0 marks a file-level directive — filled by
+# is_suppressed whenever a directive actually masks a finding, so a
+# full-tree run can report directives that masked NOTHING (stale).
+_USAGE: set[tuple[str, int, str]] | None = None
+
+
+@contextlib.contextmanager
+def record_usage():
+    """Collect which suppression directives fire during the enclosed
+    lint run; yields the live (path, lineno, rule) set."""
+    global _USAGE
+    prev, _USAGE = _USAGE, set()
+    try:
+        yield _USAGE
+    finally:
+        _USAGE = prev
+
+
 def is_suppressed(
     rule: str,
     lineno: int,
     per_line: dict[int, set[str]],
     per_file: set[str],
+    path: str | None = None,
 ) -> bool:
-    return rule in per_file or rule in per_line.get(lineno, set())
+    hit_line = rule in per_line.get(lineno, set())
+    hit_file = rule in per_file
+    if _USAGE is not None and path is not None:
+        if hit_line:
+            _USAGE.add((path, lineno, rule))
+        if hit_file:
+            _USAGE.add((path, 0, rule))
+    return hit_file or hit_line
+
+
+def declared_suppressions(root: pathlib.Path, files=None):
+    """Every inline directive in the tree: {(path, lineno, rule)} with
+    lineno 0 for file-level directives (the universe the stale sweep
+    diffs :func:`record_usage`'s hits against)."""
+    from esac_tpu.lint.ast_rules import iter_python_files
+
+    declared: set[tuple[str, int, str]] = set()
+    root = pathlib.Path(root)
+    rels = list(iter_python_files(root, files))
+    if files is None:
+        rels += [
+            p.relative_to(root).as_posix()
+            for p in sorted(root.rglob("*.sh"))
+            if not any(part.startswith(".") for part in
+                       p.relative_to(root).parts)
+        ]
+    for rel in rels:
+        try:
+            source = (root / rel).read_text()
+        except (OSError, UnicodeDecodeError):
+            continue
+        per_line, per_file = parse_suppressions(source)
+        for lineno, rules in per_line.items():
+            declared.update((rel, lineno, r) for r in rules)
+        declared.update((rel, 0, r) for r in per_file)
+    return declared
+
+
+def stale_suppressions(declared, used) -> list[str]:
+    """Human-readable notes for directives that masked nothing this run
+    — the violation was fixed (prune the directive) or the rule moved."""
+    out = []
+    for path, lineno, rule in sorted(declared - set(used)):
+        where = f"{path}:{lineno}" if lineno else f"{path} (file-level)"
+        out.append(
+            f"stale inline suppression ({rule} at {where}): the rule no "
+            "longer fires there — remove the directive (a lingering "
+            "suppression silently masks the NEXT violation)"
+        )
+    return out
 
 
 def filter_suppressed(findings, sources: dict[str, str]):
@@ -111,7 +189,8 @@ def filter_suppressed(findings, sources: dict[str, str]):
         if f.path not in cache:
             cache[f.path] = parse_suppressions(src)
         per_line, per_file = cache[f.path]
-        if not is_suppressed(f.rule, f.line, per_line, per_file):
+        if not is_suppressed(f.rule, f.line, per_line, per_file,
+                             path=f.path):
             out.append(f)
     return out
 

@@ -1,0 +1,266 @@
+"""Runtime lock witness (graft-audit v3): the dynamic half of R12/R13.
+
+The static pass (:mod:`esac_tpu.lint.lockgraph`) derives the fleet's
+lock-acquisition partial order from the AST; this module checks the
+order the fleet ACTUALLY takes at runtime.  A :class:`LockWitness`
+wraps the fleet's ``threading.Lock`` objects (Conditions are rebuilt
+over the wrapped lock, so the dispatcher's ``_work``/``_space`` aliases
+keep sharing one lock) and records:
+
+- **acquisition edges** — every time a thread acquires lock B while
+  holding lock A, keyed by the static node ids (``Class.attr``,
+  instance-collapsed), so :meth:`violations` can assert the observed
+  edge set is a subgraph of the committed ``.lock_graph.json`` order
+  (its transitive closure — the committed file is a partial order, not
+  an adjacency requirement);
+- **hold times** — per-node streaming histograms
+  (:class:`~esac_tpu.obs.metrics.StreamingHistogram`, the same bounded
+  sketch the serving fleet uses), published into an obs registry via
+  :meth:`bind_obs` as the ``lock_witness`` collector;
+- **blocked-while-held events** — an acquire that had to wait more than
+  ``blocked_threshold_s`` while the thread already held another
+  witnessed lock: the runtime shadow of an R13 finding.
+
+**Zero overhead when off** is structural, not a fast path: production
+code never imports this module and never sees a wrapped lock — the
+witness is attached by tests/benches, AFTER construction and BEFORE any
+worker thread starts (attaching while a thread waits on the old lock
+object would strand it).  ``MicroBatchDispatcher(start_worker=False)``
++ ``attach`` + ``start()`` is the pattern; the tier-1 concurrency
+stress legs (tests/test_serve.py, tests/test_obs.py) and ``python
+bench.py chaos`` ride it.
+
+The witness's own bookkeeping lock is deliberately NOT witnessed, and
+all recording happens without taking any witnessed lock — observing
+the fleet must not add edges to it.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+from esac_tpu.obs.metrics import StreamingHistogram
+
+
+class WitnessLock:
+    """Proxy around a ``threading.Lock`` that reports to a witness.
+
+    Implements the lock protocol ``threading.Condition`` relies on
+    (``acquire``/``release``/context manager; no ``_release_save`` /
+    ``_is_owned`` overrides, so Condition falls back to plain
+    release/acquire through THIS proxy and the witness sees a
+    coalescing wait as release -> reacquire, exactly what happens)."""
+
+    __slots__ = ("_raw", "_witness", "name")
+
+    def __init__(self, raw, name: str, witness: "LockWitness"):
+        self._raw = raw
+        self.name = name
+        self._witness = witness
+
+    def acquire(self, blocking=True, timeout=-1):
+        t0 = time.perf_counter()
+        ok = self._raw.acquire(blocking, timeout)
+        if ok:
+            self._witness._acquired(self.name, time.perf_counter() - t0)
+        return ok
+
+    def release(self):
+        self._witness._released(self.name)
+        self._raw.release()
+
+    def locked(self):
+        return self._raw.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"<WitnessLock {self.name} over {self._raw!r}>"
+
+
+class LockWitness:
+    """Records acquisition edges, hold times, and blocked-while-held
+    events across every lock wrapped through :meth:`wrap`/:meth:`attach`
+    (see the module docstring for the attach-before-start contract)."""
+
+    def __init__(self, blocked_threshold_s: float = 1e-3):
+        self._mu = threading.Lock()   # witness-internal; never witnessed
+        self._tls = threading.local()
+        self._edges: collections.Counter = collections.Counter()
+        self._holds: dict[str, StreamingHistogram] = {}
+        self._blocked: collections.deque = collections.deque(maxlen=1000)
+        self._thresh = blocked_threshold_s
+
+    # ---- wrapping ----
+
+    def wrap(self, raw, name: str) -> WitnessLock:
+        if isinstance(raw, WitnessLock):
+            return raw
+        return WitnessLock(raw, name, self)
+
+    def attach(self, obj, *attrs) -> "LockWitness":
+        """Wrap ``obj.<attr>`` in place for each attr, naming the node
+        ``type(obj).__name__ + '.' + attr`` — the SAME id the static
+        graph uses, instance-collapsed.  Conditions on the instance that
+        wrap the raw lock are rebuilt over the proxy, so aliases keep
+        aliasing.  Idempotent.  Attach before any thread can hold or
+        wait on the lock."""
+        for attr in attrs:
+            raw = getattr(obj, attr)
+            if isinstance(raw, WitnessLock):
+                continue
+            wrapped = self.wrap(raw, f"{type(obj).__name__}.{attr}")
+            setattr(obj, attr, wrapped)
+            try:
+                items = list(vars(obj).items())
+            except TypeError:  # __slots__ classes carry no Conditions here
+                items = []
+            for other, val in items:
+                if isinstance(val, threading.Condition) and \
+                        val._lock is raw:
+                    setattr(obj, other, threading.Condition(wrapped))
+        return self
+
+    def attach_obs(self, metrics) -> "LockWitness":
+        """Wrap a :class:`~esac_tpu.obs.MetricsRegistry`'s own lock plus
+        every registered instrument's lock and every EXISTING histogram
+        child's.  Children created after attach stay unwrapped — their
+        acquisitions simply go unobserved, which only shrinks the
+        observed set (the subgraph check is one-sided)."""
+        self.attach(metrics, "_lock")
+        for inst in list(metrics._metrics.values()):
+            self.attach(inst, "_lock")
+            for child in list(getattr(inst, "_children", {}).values()):
+                self.attach(child, "_lock")
+        return self
+
+    def attach_fleet(self, disp=None, registry=None, injector=None,
+                     ) -> "LockWitness":
+        """One-call wiring for the shipped fleet shapes: a
+        MicroBatchDispatcher (lock + conditions + its obs instruments),
+        a SceneRegistry (health/program locks, manifest, weight cache,
+        its obs registry), and optionally a FaultInjector."""
+        if registry is not None:
+            self.attach(registry, "_health_lock", "_fns_lock")
+            self.attach(registry.manifest, "_lock")
+            self.attach(registry.cache, "_lock")
+            self.attach_obs(registry.obs)
+        if disp is not None:
+            self.attach(disp, "_lock")
+            self.attach_obs(disp.obs)
+        if injector is not None:
+            self.attach(injector, "_lock")
+        return self
+
+    # ---- recording (called from WitnessLock; no witnessed lock taken) ----
+
+    def _stack(self):
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _acquired(self, name: str, waited_s: float) -> None:
+        st = self._stack()
+        if st:
+            held = [h for h, _ in st]
+            with self._mu:
+                for h in held:
+                    self._edges[(h, name)] += 1
+                if waited_s >= self._thresh:
+                    self._blocked.append({
+                        "held": held, "wanted": name,
+                        "waited_s": round(waited_s, 6),
+                    })
+        st.append((name, time.perf_counter()))
+
+    def _released(self, name: str) -> None:
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i][0] == name:
+                _, t0 = st.pop(i)
+                hold = time.perf_counter() - t0
+                with self._mu:
+                    h = self._holds.get(name)
+                    if h is None:
+                        h = self._holds[name] = StreamingHistogram()
+                h.observe(hold)
+                return
+        # Release with no recorded acquire: the lock was taken before
+        # attach. Ignore — bookkeeping starts at the first clean acquire.
+
+    # ---- reading ----
+
+    def edges(self) -> dict[tuple[str, str], int]:
+        with self._mu:
+            return dict(self._edges)
+
+    def hold_summary(self) -> dict[str, dict]:
+        with self._mu:
+            holds = dict(self._holds)
+        return {name: holds[name].summary() for name in sorted(holds)}
+
+    def blocked_events(self) -> list[dict]:
+        with self._mu:
+            return [dict(e) for e in self._blocked]
+
+    def snapshot(self) -> dict:
+        """The ``lock_witness`` obs collector payload: observed edges,
+        per-lock hold-time summaries, blocked-while-held events."""
+        return {
+            "edges": {f"{s}->{d}": n for (s, d), n in
+                      sorted(self.edges().items())},
+            "holds": self.hold_summary(),
+            "blocked_while_held": self.blocked_events(),
+        }
+
+    def bind_obs(self, metrics, name: str = "lock_witness") -> None:
+        """Publish hold-time histograms + observed edges into an obs
+        registry as a pull collector (DESIGN.md §14 pattern)."""
+        metrics.register_collector(name, self.snapshot)
+
+    # ---- the gate ----
+
+    def violations(self, committed_graph: dict) -> list[str]:
+        """Observed edges NOT sanctioned by the committed partial order
+        (its transitive closure).  Node ids absent from the committed
+        graph are violations too — an unmodeled lock in the nest means
+        the static graph is stale."""
+        from esac_tpu.lint.lockgraph import transitive_closure
+
+        allowed = transitive_closure(committed_graph.get("edges", []))
+        nodes = committed_graph.get("nodes", {})
+        out = []
+        for (src, dst), n in sorted(self.edges().items()):
+            if src not in nodes or dst not in nodes:
+                out.append(
+                    f"{src}->{dst} (x{n}): lock(s) missing from the "
+                    "committed graph nodes"
+                )
+            elif src == dst and nodes[src].get("kind") == "RLock":
+                continue  # reentrant re-acquisition: the static pass
+                #           sanctions it ('reentrant by design'), so the
+                #           runtime check must not call it a violation
+            elif (src, dst) not in allowed:
+                out.append(
+                    f"{src}->{dst} (x{n}): acquisition order not in the "
+                    "committed .lock_graph.json partial order"
+                )
+        return out
+
+    def assert_subgraph(self, committed_graph: dict) -> None:
+        v = self.violations(committed_graph)
+        if v:
+            raise AssertionError(
+                "observed lock acquisitions escape the committed order "
+                "(regenerate + review .lock_graph.json if intentional):\n"
+                + "\n".join(v)
+            )

@@ -34,6 +34,11 @@ go owner -> instrument.  Nothing here ever calls back into an owner
 while holding an instrument lock, so the order is acyclic — and
 ``snapshot()`` runs collectors OUTSIDE the registry lock, so a slow
 collector cannot block concurrent instrument writes behind the registry.
+Since graft-audit v3 this order is MACHINE-CHECKED, not prose: the
+owner->instrument edges are committed in ``.lock_graph.json`` (R12,
+DESIGN.md §15), a new nesting fails the lint until reviewed, and the
+runtime witness (lint/witness.py) asserts the edges actually taken
+under the concurrency stress legs stay inside that order.
 
 Pure host code: no jax import anywhere in this package (observability
 must never become a TPU relay client, CLAUDE.md hazards).

@@ -407,7 +407,13 @@ class SceneRegistry:
     instance lock (graft-lint R10); pointer/cache actions derived from a
     trip are executed OUTSIDE it (single-shot, guarded by the tripped
     set) to keep the lock order registry-health -> manifest/cache free
-    of cycles.
+    of cycles.  Since graft-audit v3 that order is machine-checked: the
+    health -> manifest and health -> obs-counter edges are committed in
+    ``.lock_graph.json`` (R12, DESIGN.md §15) — ``_act`` staying OUTSIDE
+    the health lock is exactly why no health -> cache edge exists — and
+    R13 pins that nothing blocks under these locks (loads ride the
+    cache's per-key futures; probe device syncs are deferred off-lock
+    in ``_drain_probes``).
     """
 
     def __init__(

@@ -221,7 +221,10 @@ class MicroBatchDispatcher:
         )
         self._n_pending = 0
         self._closed = False
-        # SLO state (all guarded by self._lock, graft-lint R10): the worker
+        # SLO state (all guarded by self._lock, graft-lint R10; the
+        # fleet-level nesting this class takes — dispatcher lock ->
+        # obs instrument locks, never anything else — is the committed
+        # .lock_graph.json order, R12/R13 + DESIGN.md §15): the worker
         # generation counter lets the watchdog abandon a wedged worker — a
         # stale-generation worker discards whatever it eventually returns
         # and exits; quarantined maps lane -> reason; the dispatch-time EMA

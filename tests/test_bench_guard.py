@@ -767,6 +767,15 @@ def test_chaos_artifact_schema_committed():
     assert faults["nan_weights"]["post_rollback_bit_identical"] is True
     assert chaos["compiled_programs"]["hot_path_recompiles"] == 0
     assert chaos["canary"]["finalized"] in (True, False)
+    # graft-audit v3: the runtime lock witness rode the drill — the
+    # acquisition edges the fault paths actually took are a subgraph of
+    # the committed .lock_graph.json order, violation-free.
+    lw = chaos["lock_witness"]
+    assert lw["committed_graph_present"] is True
+    assert lw["violations"] == []
+    assert lw["observed_subgraph_of_committed"] is True
+    assert any(k.startswith("MicroBatchDispatcher._lock->")
+               for k in lw["edges_observed"]), lw["edges_observed"]
 
 
 def test_all_mode_mains_share_the_wedge_safe_scaffold(monkeypatch):

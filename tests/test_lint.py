@@ -1271,10 +1271,24 @@ def test_committed_ledger_quantifies_the_scoring_errmap():
 def test_lint_wall_clock_recorded_and_inside_budget(traced_registry):
     """Record the lint gate's own wall clock in .tier1_wall.json (merged —
     conftest preserves foreign keys) so the tier-1 budget math is visible:
-    layer 1 + one shared tracing pass must stay a small fraction of 870s."""
+    layer 1 + one shared tracing pass must stay a small fraction of 870s.
+    run_layer1 now INCLUDES the graft-audit v3 lock-graph pass (R12/R13
+    over the fleet scope), and the committed-graph diff is timed
+    explicitly below — the lock-graph wall clock folds into the same
+    lint_wall_s record, budget assertion intact."""
+    from esac_tpu.lint.lockgraph import (
+        LOCK_GRAPH_NAME,
+        build_graph,
+        diff_graph,
+        load_graph,
+    )
+
     _, trace_s = traced_registry
     t0 = time.perf_counter()
     run_layer1(REPO)
+    committed = load_graph(REPO / LOCK_GRAPH_NAME)
+    if committed is not None:
+        diff_graph(committed, build_graph(REPO))
     layer1_s = time.perf_counter() - t0
     total = trace_s + layer1_s
     wall_file = REPO / ".tier1_wall.json"
@@ -1289,6 +1303,48 @@ def test_lint_wall_clock_recorded_and_inside_budget(traced_registry):
     assert total < 240, (
         f"lint gate took {total:.0f}s — too large a share of the 870s "
         "tier-1 budget; trim the registry trace shapes"
+    )
+
+
+# --------------------------------------------------------------------------
+# graft-audit v3: the committed lock-graph gate (tests/test_lockgraph.py
+# carries the fixture-level R12/R13 and witness coverage)
+
+def test_committed_lock_graph_matches_tree_exactly():
+    """The tier-1 lock-graph gate, ledger-style: the committed
+    .lock_graph.json equals the recomputed fleet analysis exactly — any
+    drift means regenerate-and-review (--write-lock-graph), any
+    unreviewed new edge means exit 1 (R12 diff gate)."""
+    from esac_tpu.lint.lockgraph import (
+        LOCK_GRAPH_NAME,
+        build_graph,
+        diff_graph,
+        load_graph,
+    )
+
+    current = build_graph(REPO)
+    committed = load_graph(REPO / LOCK_GRAPH_NAME)
+    assert committed is not None, \
+        "no committed lock graph: run `python -m esac_tpu.lint " \
+        "--write-lock-graph` and review the edges"
+    findings, stale = diff_graph(committed, current)
+    assert findings == [], "\n".join(f.format() for f in findings)
+    assert stale == [], "\n".join(stale)
+    assert committed == json.loads(json.dumps(current)), \
+        "lock graph drift: regenerate with --write-lock-graph and review"
+
+
+def test_changed_mode_lock_pass_rides_fleet_and_lint_edits():
+    """--changed skips the lock-graph pass unless a fleet
+    (serve/registry/obs) or lint file changed — the jaxpr-layer skip
+    mirrored (satellite of ISSUE 11)."""
+    from esac_tpu.lint.lockgraph import lock_pass_needed
+
+    assert lock_pass_needed(None)
+    assert lock_pass_needed(["esac_tpu/serve/slo.py"])
+    assert lock_pass_needed(["esac_tpu/lint/registry.py"])
+    assert not lock_pass_needed(
+        ["esac_tpu/utils/num.py", "bench.py", "DESIGN.md"]
     )
 
 

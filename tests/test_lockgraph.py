@@ -1,0 +1,799 @@
+"""graft-audit v3 tests: the R12/R13 fleet concurrency analysis, the
+committed lock-graph artifact machinery, and the runtime lock witness.
+
+Golden trigger + near-miss fixtures ride tmp_path trees mimicking the
+fleet layout (the pass is scoped to esac_tpu/{serve,registry,obs}/),
+exactly like test_lint.py.  The repo-level gates — committed graph
+matches the tree exactly, analysis clean — live in test_lint.py next to
+their ledger siblings; here the REAL fleet map is pinned edge-by-edge so
+a lock-domain change cannot slip through as "just drift".
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import textwrap
+import threading
+import time
+
+import pytest
+
+from esac_tpu.lint.cli import main as lint_main
+from esac_tpu.lint.lockgraph import (
+    LOCK_GRAPH_NAME,
+    analyze,
+    build_graph,
+    diff_graph,
+    load_graph,
+    lock_pass_needed,
+    run_lock_rules,
+    transitive_closure,
+    write_graph,
+)
+from esac_tpu.lint.witness import LockWitness, WitnessLock
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _write(root: pathlib.Path, rel: str, text: str) -> str:
+    p = root / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(text))
+    return rel
+
+
+def _edge_pairs(graph: dict) -> set[tuple[str, str]]:
+    return {(e["src"], e["dst"]) for e in graph["edges"]}
+
+
+# --------------------------------------------------------------------------
+# R12: lock-order graph
+
+def test_r12_two_class_lock_cycle_is_flagged(tmp_path):
+    """The golden trigger: Alpha takes its lock then calls into Beta
+    (which locks), Beta takes its lock then calls back into Alpha — the
+    classic AB/BA deadlock, invisible to per-class R10."""
+    _write(tmp_path, "esac_tpu/serve/cycle.py", """\
+        import threading
+
+        class Alpha:
+            def __init__(self, beta: "Beta"):
+                self._lock = threading.Lock()
+                self.beta = beta
+
+            def ping(self):
+                with self._lock:
+                    self.beta.pong_locked()
+
+            def ping_locked(self):
+                with self._lock:
+                    pass
+
+        class Beta:
+            def __init__(self, alpha: "Alpha"):
+                self._lock = threading.Lock()
+                self.alpha = alpha
+
+            def pong(self):
+                with self._lock:
+                    self.alpha.ping_locked()
+
+            def pong_locked(self):
+                with self._lock:
+                    pass
+        """)
+    a = analyze(tmp_path)
+    assert _edge_pairs(a.graph()) == {
+        ("Alpha._lock", "Beta._lock"), ("Beta._lock", "Alpha._lock"),
+    }
+    cycles = [f for f in a.findings if f.rule == "R12"]
+    assert len(cycles) == 1
+    assert cycles[0].text.startswith("cycle:")
+    assert "Alpha._lock" in cycles[0].text and "Beta._lock" in cycles[0].text
+
+
+def test_r12_condition_alias_is_one_node_not_an_edge(tmp_path):
+    """The near-miss: a Condition built over the instance lock IS that
+    lock.  Using the condition in one method and the lock in another is
+    one node with an alias — never a second node, an edge, or a
+    self-deadlock."""
+    _write(tmp_path, "esac_tpu/serve/alias.py", """\
+        import threading
+
+        class Coalescer:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._work = threading.Condition(self._lock)
+                self.ring = []
+
+            def submit(self, x):
+                with self._work:
+                    self.ring.append(x)
+                    self._work.notify()
+
+            def snapshot(self):
+                with self._lock:
+                    return list(self.ring)
+        """)
+    a = analyze(tmp_path)
+    g = a.graph()
+    assert list(g["nodes"]) == ["Coalescer._lock"]
+    assert g["nodes"]["Coalescer._lock"]["aliases"] == ["_work"]
+    assert g["edges"] == []
+    assert a.findings == []
+
+
+def test_r12_self_deadlock_through_helper_propagation(tmp_path):
+    """A helper whose call sites hold the lock re-acquiring it is a
+    self-deadlock on a non-reentrant Lock (the may-held fixpoint at
+    work); the same shape over an RLock is reentrant by design."""
+    _write(tmp_path, "esac_tpu/registry/selfdead.py", """\
+        import threading
+
+        class Bad:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self._inner()
+
+            def _inner(self):
+                with self._lock:
+                    pass
+
+        class Fine:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self._inner()
+
+            def _inner(self):
+                with self._lock:
+                    pass
+        """)
+    findings = run_lock_rules(tmp_path)
+    assert [f.rule for f in findings] == ["R12"]
+    assert "Bad._inner" in findings[0].message
+    assert "self-deadlock" in findings[0].message
+
+
+def test_lock_graph_roundtrip_and_diff(tmp_path):
+    _write(tmp_path, "esac_tpu/obs/pair.py", """\
+        import threading
+
+        class Inner:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poke(self):
+                with self._lock:
+                    pass
+
+        class Outer:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.inner = Inner()
+
+            def drive(self):
+                with self._lock:
+                    self.inner.poke()
+        """)
+    g = build_graph(tmp_path)
+    assert _edge_pairs(g) == {("Outer._lock", "Inner._lock")}
+    path = tmp_path / "graph.json"
+    write_graph(path, g)
+    loaded = load_graph(path)
+    findings, stale = diff_graph(loaded, g)
+    assert findings == [] and stale == []
+    assert load_graph(tmp_path / "missing.json") is None
+
+
+def test_lock_graph_diff_new_edge_fails_removed_edge_is_stale():
+    node = {"file": "x.py", "kind": "Lock", "aliases": []}
+    base = {
+        "nodes": {"A._lock": node, "B._lock": node},
+        "edges": [{"src": "A._lock", "dst": "B._lock", "via": ["A.m"]}],
+    }
+    grown = {
+        "nodes": dict(base["nodes"]),
+        "edges": base["edges"] + [
+            {"src": "B._lock", "dst": "A._lock", "via": ["B.n"]}
+        ],
+    }
+    findings, stale = diff_graph(base, grown)
+    assert [f.rule for f in findings] == ["R12"]
+    assert findings[0].text == "edge:B._lock->A._lock"
+    assert "unreviewed" in findings[0].message
+    # The reverse direction — a committed edge no code path takes any
+    # more — is stale (regenerate + review), never a failure.
+    findings, stale = diff_graph(grown, base)
+    assert findings == []
+    assert any("no longer taken" in s for s in stale)
+    # Same edge, different acquiring methods: drift, reported stale.
+    moved = {
+        "nodes": dict(base["nodes"]),
+        "edges": [{"src": "A._lock", "dst": "B._lock", "via": ["A.other"]}],
+    }
+    findings, stale = diff_graph(base, moved)
+    assert findings == []
+    assert any("provenance" in s for s in stale)
+    # Node drift both ways is stale.
+    fewer = {"nodes": {"A._lock": node}, "edges": []}
+    _, stale = diff_graph(base, fewer)
+    assert any("no longer exists" in s for s in stale)
+    _, stale = diff_graph(fewer, base)
+    assert any("is new" in s for s in stale)
+
+
+# --------------------------------------------------------------------------
+# R13: blocking-under-lock
+
+def test_r13_blocking_calls_under_lock(tmp_path):
+    """Golden triggers: a sleep under the lock directly, an Event.wait
+    under the lock, and a blocking call reached through a helper whose
+    call site holds the lock (interprocedural propagation)."""
+    _write(tmp_path, "esac_tpu/serve/blocky.py", """\
+        import threading
+        import time
+
+        class Blocky:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._ready = threading.Event()
+
+            def sleepy(self):
+                with self._lock:
+                    time.sleep(0.1)
+
+            def waity(self):
+                with self._lock:
+                    self._ready.wait()
+
+            def outer(self):
+                with self._lock:
+                    self._slow()
+
+            def _slow(self):
+                time.sleep(0.1)
+        """)
+    findings = run_lock_rules(tmp_path)
+    assert [f.rule for f in findings] == ["R13", "R13", "R13"]
+    msgs = "\n".join(f.message for f in findings)
+    assert "Blocky.sleepy" in msgs and "Blocky.waity" in msgs \
+        and "Blocky._slow" in msgs
+    assert all("Blocky._lock" in f.message for f in findings)
+
+
+def test_r13_release_then_wait_and_coalescing_idiom_are_near_misses(tmp_path):
+    """The two sanctioned shapes: snapshot under the lock then block
+    OUTSIDE it (the _drain_probes / cache-load pattern), and the
+    coalescing Condition.wait — the condition aliases the ONLY held
+    lock, so the wait RELEASES it."""
+    _write(tmp_path, "esac_tpu/registry/clean_wait.py", """\
+        import threading
+        import time
+
+        class Clean:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._work = threading.Condition(self._lock)
+                self._ready = threading.Event()
+                self.pending = []
+
+            def drain(self):
+                with self._lock:
+                    batch = list(self.pending)
+                    self.pending.clear()
+                self._ready.wait()          # blocking AFTER release
+                time.sleep(0.01)            # likewise
+                return batch
+
+            def coalesce(self):
+                with self._work:
+                    while not self.pending:
+                        self._work.wait()   # releases the aliased lock
+                    return self.pending.pop()
+        """)
+    assert run_lock_rules(tmp_path) == []
+
+
+def test_r13_condition_wait_holding_a_second_lock_still_flags(tmp_path):
+    """The alias allowlist releases ONLY the condition's own lock: a
+    wait that keeps a second lock pinned across it blocks that lock's
+    waiters unboundedly — flagged."""
+    _write(tmp_path, "esac_tpu/serve/two_locks.py", """\
+        import threading
+
+        class TwoLocks:
+            def __init__(self):
+                self._stats_lock = threading.Lock()
+                self._lock = threading.Lock()
+                self._work = threading.Condition(self._lock)
+
+            def bad_wait(self):
+                with self._stats_lock:
+                    with self._work:
+                        self._work.wait()
+        """)
+    findings = run_lock_rules(tmp_path)
+    r13 = [f for f in findings if f.rule == "R13"]
+    assert len(r13) == 1
+    assert "TwoLocks._stats_lock" in r13[0].message
+    assert "TwoLocks._lock" not in r13[0].message  # released by the wait
+
+
+def test_r13_typed_cross_class_blocking_and_suppression(tmp_path):
+    """A blocking call reached through a TYPED attribute call chain
+    (the dispatcher→cache shape) is flagged in the callee's file; an
+    inline ``disable=R13(reason)`` on the blocking line silences it."""
+    _write(tmp_path, "esac_tpu/registry/xcache.py", """\
+        import threading
+
+        class Loader:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._fut = threading.Event()
+
+            def fetch(self):
+                self._fut.wait()
+                return 1
+
+        class Facade:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.loader = Loader()
+
+            def resolve(self):
+                with self._lock:
+                    return self.loader.fetch()
+        """)
+    findings = run_lock_rules(tmp_path)
+    assert [f.rule for f in findings] == ["R13"]
+    assert findings[0].path == "esac_tpu/registry/xcache.py"
+    assert "Loader.fetch" in findings[0].message
+    assert "Facade._lock" in findings[0].message
+    # Reviewed case: the suppression sits on the blocking line.
+    _write(tmp_path, "esac_tpu/registry/xcache.py", """\
+        import threading
+
+        class Loader:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._fut = threading.Event()
+
+            def fetch(self):
+                self._fut.wait()  # graft-lint: disable=R13(fixture: bounded by test harness)
+                return 1
+
+        class Facade:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.loader = Loader()
+
+            def resolve(self):
+                with self._lock:
+                    return self.loader.fetch()
+        """)
+    assert run_lock_rules(tmp_path) == []
+
+
+def test_r13_name_collision_still_walks_both_classes(tmp_path):
+    """Two same-named classes in different fleet files drop out of TYPED
+    dispatch only — their own acquisitions and blocking calls are still
+    analyzed (review finding: dropping them from the walk entirely would
+    hide a real deadlock behind a name collision)."""
+    _write(tmp_path, "esac_tpu/serve/dup_a.py", """\
+        import threading
+        import time
+
+        class Probe:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(0.1)
+        """)
+    _write(tmp_path, "esac_tpu/registry/dup_b.py", """\
+        import threading
+
+        class Probe:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def fine(self):
+                with self._lock:
+                    pass
+        """)
+    findings = run_lock_rules(tmp_path)
+    assert [f.rule for f in findings] == ["R13"]
+    assert findings[0].path == "esac_tpu/serve/dup_a.py"
+    # Both collided classes' locks still appear (merged on the shared id).
+    assert "Probe._lock" in build_graph(tmp_path)["nodes"]
+
+
+# --------------------------------------------------------------------------
+# the repo's own fleet map
+
+def test_repo_fleet_lock_map_is_exactly_the_committed_five_edges():
+    """Pin the REAL fleet's lock-order graph edge-for-edge (DESIGN.md
+    §15): dispatcher → {counter, histogram-vec, streaming-histogram}
+    (accounting published inside the dispatch critical sections),
+    registry health → counter (_record_event), and health → manifest
+    (_judge_locked's rollback-target reads).  A new lock domain or a
+    new nesting MUST show up here as a reviewed diff, not as drift."""
+    g = build_graph(REPO)
+    assert _edge_pairs(g) == {
+        ("MicroBatchDispatcher._lock", "CounterVec._lock"),
+        ("MicroBatchDispatcher._lock", "HistogramVec._lock"),
+        ("MicroBatchDispatcher._lock", "StreamingHistogram._lock"),
+        ("SceneRegistry._health_lock", "CounterVec._lock"),
+        ("SceneRegistry._health_lock", "SceneManifest._lock"),
+    }
+    # The dispatcher's Condition aliases collapse onto one node.
+    disp = g["nodes"]["MicroBatchDispatcher._lock"]
+    assert disp["aliases"] == ["_space", "_work"]
+    # And the whole fleet is R12/R13 clean — the first full-tree run's
+    # verdict, pinned: the coalescing waits and the
+    # snapshot-then-block-outside idioms must keep classifying as
+    # near-misses, not findings.
+    assert run_lock_rules(REPO) == []
+
+
+def test_lock_pass_changed_mode_skip_condition():
+    """--changed skips the (fleet-global) lock pass unless a
+    serve/registry/obs/lint file changed — the jaxpr-layer skip,
+    mirrored."""
+    assert lock_pass_needed(None)
+    assert lock_pass_needed(["esac_tpu/serve/dispatcher.py"])
+    assert lock_pass_needed(["esac_tpu/registry/cache.py"])
+    assert lock_pass_needed(["esac_tpu/obs/metrics.py"])
+    assert lock_pass_needed(["esac_tpu/lint/lockgraph.py"])
+    assert not lock_pass_needed(
+        ["esac_tpu/geometry/pnp.py", "bench.py", "LINT.md",
+         "tests/test_serve.py"]
+    )
+
+
+# --------------------------------------------------------------------------
+# CLI: the committed-artifact gate end to end
+
+def _audited_fleet_tree(tmp_path):
+    _write(tmp_path, "esac_tpu/lint/registry.py", "R11_WAIVED = {}\n")
+    _write(tmp_path, "esac_tpu/serve/pairs.py", """\
+        import threading
+
+        class Inner:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poke(self):
+                with self._lock:
+                    pass
+
+        class Outer:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.inner = Inner()
+
+            def drive(self):
+                with self._lock:
+                    self.inner.poke()
+        """)
+
+
+def test_cli_lock_graph_gate(tmp_path, capsys):
+    """An audited tree without a committed graph fails typed (R12
+    missing-lock-graph); --write-lock-graph + rerun is clean; a new
+    nesting then fails as an unreviewed edge with a stable json id."""
+    _audited_fleet_tree(tmp_path)
+    rc = lint_main(["--root", str(tmp_path), "--no-jaxpr"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "no committed lock-order graph" in out
+
+    assert lint_main(["--root", str(tmp_path), "--no-jaxpr",
+                      "--write-lock-graph"]) == 0
+    capsys.readouterr()
+    assert lint_main(["--root", str(tmp_path), "--no-jaxpr"]) == 0
+    capsys.readouterr()
+
+    # Grow a new nesting: Inner now calls BACK into a third lock.
+    _write(tmp_path, "esac_tpu/serve/growth.py", """\
+        import threading
+
+        from esac_tpu.serve.pairs import Inner
+
+        class Third:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.inner = Inner()
+
+            def drive(self):
+                with self._lock:
+                    self.inner.poke()
+        """)
+    rc = lint_main(["--root", str(tmp_path), "--no-jaxpr",
+                    "--format", "json"])
+    captured = capsys.readouterr()
+    assert rc == 1
+    objs = [json.loads(line) for line in
+            captured.out.strip().splitlines()]
+    edge = [o for o in objs if o["rule"] == "R12"]
+    assert len(edge) == 1
+    assert edge[0]["text"] == "edge:Third._lock->Inner._lock"
+    assert edge[0]["id"].startswith("R12-")
+    # New-node drift rides stderr as stale notes, not findings.
+    assert "is new and not in the committed graph" in captured.err
+
+
+def test_cli_json_r13_duplicate_ids_get_ordinals(tmp_path, capsys):
+    """Two textually identical R13 lines share the line-number-free
+    identity; the json ids still disambiguate via ordinals (the R12/R13
+    ids ride the same driver contract as R1-R11)."""
+    _write(tmp_path, "esac_tpu/serve/twice.py", """\
+        import threading
+        import time
+
+        class Twice:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def a(self):
+                with self._lock:
+                    time.sleep(0.1)
+
+            def b(self):
+                with self._lock:
+                    time.sleep(0.1)
+        """)
+    rc = lint_main(["--root", str(tmp_path), "--no-jaxpr",
+                    "--format", "json"])
+    ids = [json.loads(l)["id"] for l in
+           capsys.readouterr().out.strip().splitlines()]
+    assert rc == 1 and len(ids) == 2
+    assert len(set(ids)) == 2
+    assert ids[1] == ids[0] + "-2"
+
+
+# --------------------------------------------------------------------------
+# stale-suppression sweep
+
+def test_stale_suppression_sweep(tmp_path):
+    from esac_tpu.lint import run_layer1
+    from esac_tpu.lint.suppress import (
+        declared_suppressions,
+        record_usage,
+        stale_suppressions,
+    )
+
+    # One directive that actually masks a finding, one that masks nothing.
+    _write(tmp_path, "esac_tpu/geometry/sup.py", """\
+        import jax.numpy as jnp
+
+        def n(v):
+            return jnp.linalg.norm(v)  # graft-lint: disable=R2(fixture reason)
+
+        def clean(v):
+            return v  # graft-lint: disable=R4(nothing to mask here)
+        """)
+    with record_usage() as used:
+        assert run_layer1(tmp_path) == []
+    notes = stale_suppressions(declared_suppressions(tmp_path), used)
+    assert len(notes) == 1
+    assert "R4" in notes[0] and "sup.py:7" in notes[0]
+
+
+def test_stale_r11_waiver_sweep(tmp_path):
+    from esac_tpu.lint.ast_rules import stale_r11_waivers
+
+    _write(tmp_path, "esac_tpu/lint/registry.py", """\
+        R11_WAIVED = {
+            "real_entry": "fixture: covered transitively",
+            "ghost_entry": "fixture: the function this waived is gone",
+        }
+        """)
+    _write(tmp_path, "esac_tpu/ransac/entries.py", """\
+        import jax
+
+        @jax.jit
+        def real_entry(x):
+            return x
+        """)
+    notes = stale_r11_waivers(tmp_path)
+    assert len(notes) == 1
+    assert "ghost_entry" in notes[0]
+    # The repo's own waiver table carries no dangling names.
+    assert stale_r11_waivers(REPO) == []
+
+
+# --------------------------------------------------------------------------
+# the runtime lock witness
+
+def _mini_graph():
+    node = {"file": "x.py", "kind": "Lock", "aliases": []}
+    return {
+        "nodes": {"A._lock": node, "B._lock": node, "C._lock": node},
+        "edges": [
+            {"src": "A._lock", "dst": "B._lock", "via": ["A.m"]},
+            {"src": "B._lock", "dst": "C._lock", "via": ["B.m"]},
+        ],
+    }
+
+
+def test_witness_subgraph_check_and_transitive_closure():
+    """In-order acquisition passes; the closure sanctions A->C through
+    B; an INJECTED out-of-order acquisition (C before A) is caught —
+    the acceptance-criteria injection test."""
+    w = LockWitness()
+    a = w.wrap(threading.Lock(), "A._lock")
+    b = w.wrap(threading.Lock(), "B._lock")
+    c = w.wrap(threading.Lock(), "C._lock")
+    committed = _mini_graph()
+    with a:
+        with b:
+            with c:
+                pass
+    with a:
+        with c:  # skips B: allowed — the committed ORDER has A before C
+            pass
+    assert w.violations(committed) == []
+    assert ("A._lock", "C._lock") in transitive_closure(committed["edges"])
+    w.assert_subgraph(committed)
+
+    with c:
+        with a:  # out of order: injected violation
+            pass
+    v = w.violations(committed)
+    assert len(v) == 1 and v[0].startswith("C._lock->A._lock")
+    with pytest.raises(AssertionError, match="C._lock->A._lock"):
+        w.assert_subgraph(committed)
+
+
+def test_witness_allows_rlock_reentry_like_the_static_pass():
+    """Re-acquiring an RLock records a self-edge, but violations() must
+    sanction it exactly as the static pass does ('reentrant by design');
+    a self-edge on a non-reentrant Lock node still flags (review
+    finding: the two halves must agree on RLock policy)."""
+    w = LockWitness()
+    r = w.wrap(threading.RLock(), "R._lock")
+    with r:
+        with r:
+            pass
+    committed = {
+        "nodes": {"R._lock": {"file": "x.py", "kind": "RLock",
+                              "aliases": []}},
+        "edges": [],
+    }
+    assert w.violations(committed) == []
+    # The same observation against a Lock-kind node is a violation.
+    committed["nodes"]["R._lock"]["kind"] = "Lock"
+    assert len(w.violations(committed)) == 1
+
+
+def test_filter_suppressed_records_usage():
+    """filter_suppressed participates in the stale-suppression sweep:
+    a directive it honors counts as USED (review finding: the fallback
+    path previously skipped recording and would report live directives
+    stale)."""
+    from esac_tpu.lint.findings import Finding
+    from esac_tpu.lint.suppress import filter_suppressed, record_usage
+
+    f = Finding("R2", "pkg/x.py", 2, "y = norm(v)", "msg")
+    src = "# file\ny = norm(v)  # graft-lint: disable=R2(reviewed)\n"
+    with record_usage() as used:
+        out = filter_suppressed([f], {"pkg/x.py": src})
+    assert out == []
+    assert ("pkg/x.py", 2, "R2") in used
+
+
+def test_witness_flags_locks_missing_from_committed_nodes():
+    w = LockWitness()
+    a = w.wrap(threading.Lock(), "A._lock")
+    x = w.wrap(threading.Lock(), "Rogue._lock")
+    with a:
+        with x:
+            pass
+    v = w.violations(_mini_graph())
+    assert len(v) == 1 and "missing from the committed graph" in v[0]
+
+
+def test_witness_attach_rebuilds_conditions_and_records_holds():
+    """attach() wraps in place and re-points Conditions at the wrapped
+    lock, so the coalescing wait keeps working (wait releases, notify
+    wakes) and hold times land in the histograms."""
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._work = threading.Condition(self._lock)
+            self.items = []
+
+    box = Box()
+    w = LockWitness()
+    w.attach(box, "_lock")
+    assert isinstance(box._lock, WitnessLock)
+    assert box._work._lock is box._lock  # the rebuilt alias
+
+    def producer():
+        time.sleep(0.05)
+        with box._work:
+            box.items.append(1)
+            box._work.notify()
+
+    t = threading.Thread(target=producer)
+    t.start()
+    with box._work:
+        while not box.items:
+            box._work.wait(5.0)
+    t.join(5.0)
+    assert box.items == [1]
+    holds = w.hold_summary()
+    assert holds["Box._lock"]["count"] >= 2
+    assert holds["Box._lock"]["max"] < 5.0  # the wait RELEASED the lock
+
+
+def test_witness_blocked_while_held_events_and_obs_collector():
+    from esac_tpu.obs import MetricsRegistry
+
+    w = LockWitness(blocked_threshold_s=1e-4)
+    a = w.wrap(threading.Lock(), "A._lock")
+    b = w.wrap(threading.Lock(), "B._lock")
+
+    def holder():
+        with a:
+            time.sleep(0.05)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    time.sleep(0.01)
+    with b:
+        with a:  # blocks ~40ms while holding B — the R13 shape, observed
+            pass
+    t.join(5.0)
+    events = w.blocked_events()
+    assert any(e["wanted"] == "A._lock" and e["held"] == ["B._lock"]
+               and e["waited_s"] > 0.01 for e in events)
+
+    reg = MetricsRegistry()
+    w.bind_obs(reg)
+    snap = reg.snapshot()
+    lw = snap["collectors"]["lock_witness"]
+    assert "B._lock->A._lock" in lw["edges"]
+    assert lw["holds"]["A._lock"]["count"] >= 2
+    json.dumps(snap)  # the collector payload rides the snapshot contract
+
+
+def test_witness_wrap_is_idempotent_and_off_means_plain_locks():
+    """Double-attach never double-wraps; and with no witness in play a
+    dispatcher's locks are plain threading primitives — the structural
+    zero-overhead-when-off property (production code never imports the
+    witness; tests attach explicitly)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from esac_tpu.ransac import RansacConfig
+    from esac_tpu.serve import MicroBatchDispatcher
+
+    w = LockWitness()
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+    box = Box()
+    w.attach(box, "_lock")
+    first = box._lock
+    w.attach(box, "_lock")
+    assert box._lock is first  # idempotent
+
+    disp = MicroBatchDispatcher(lambda t: t, RansacConfig(),
+                                start_worker=False)
+    try:
+        assert not isinstance(disp._lock, WitnessLock)
+        assert type(disp._lock).__module__ == "_thread"
+    finally:
+        disp.close()

@@ -440,11 +440,30 @@ def test_concurrent_snapshots_consistent_and_admission_unblocked():
     """The R10 stress leg of the obs layer: serving threads race
     snapshot/export readers; every mid-traffic snapshot's outcome
     classes + pending must sum EXACTLY to offered, and the export
-    surface must never corrupt or raise."""
+    surface must never corrupt or raise.
+
+    graft-audit v3 rides the same leg with the runtime lock witness:
+    the snapshot/export/collector machinery's ACTUAL acquisition edges
+    must stay inside the committed .lock_graph.json order (the
+    registry -> owner -> instrument order the obs module docstring
+    states, now machine-checked at runtime too)."""
+    import pathlib as _pathlib
+
+    from esac_tpu.lint.lockgraph import LOCK_GRAPH_NAME, load_graph
+    from esac_tpu.lint.witness import LockWitness
+
     cfg = RansacConfig(n_hyps=8, frame_buckets=(1, 4),
                        serve_max_wait_ms=1.0, serve_queue_depth=64)
     disp = MicroBatchDispatcher(_echo, cfg, trace=True,
-                                slo=SLOPolicy(deadline_ms=60_000.0))
+                                slo=SLOPolicy(deadline_ms=60_000.0),
+                                start_worker=False)
+    # Warm the sync path once so the fleet latency/stage histogram
+    # children exist for the witness to wrap, then re-base the books so
+    # the exact-accounting assertions below stay exact.
+    disp.infer_one(_frame(-1.0), scene="warm", timeout=60.0)
+    disp.reset_stats()
+    witness = LockWitness().attach_fleet(disp=disp)
+    disp.start()
     n_callers, n_each = 3, 40
     errors: list[Exception] = []
     done = threading.Event()
@@ -485,6 +504,16 @@ def test_concurrent_snapshots_consistent_and_admission_unblocked():
     assert errors == [], errors
     t = disp.slo_totals()
     assert t["served"] == n_callers * n_each == t["offered"]
+    # graft-audit v3: observed acquisition edges ⊆ committed order, and
+    # the publish-under-dispatch-lock edge was actually exercised.
+    committed = load_graph(
+        _pathlib.Path(__file__).resolve().parent.parent / LOCK_GRAPH_NAME
+    )
+    assert committed is not None, "no committed .lock_graph.json"
+    witness.assert_subgraph(committed)
+    assert any(src == "MicroBatchDispatcher._lock"
+               for (src, _dst) in witness.edges())
+    assert witness.hold_summary()["MicroBatchDispatcher._lock"]["count"] > 0
 
 
 def test_snapshot_and_admission_never_block_on_wedged_dispatch():

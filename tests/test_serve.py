@@ -271,16 +271,37 @@ def test_heavy_dispatcher_concurrent_infer_one_and_stats_reads():
     the bounded stat rings nor raise — the runtime behavior the static
     lock-discipline model (lint/concurrency.py) certifies.  Every shared
     structure the readers touch goes through the lock-taking public
-    surface, so a torn read here means R10's model and the code diverged."""
+    surface, so a torn read here means R10's model and the code diverged.
+
+    Since graft-audit v3 the leg also carries the runtime lock witness:
+    every acquisition edge the stress actually takes must be a subgraph
+    of the committed .lock_graph.json order (lint/lockgraph.py), and the
+    hold-time histograms must populate.  The witness attaches BEFORE the
+    worker starts (start_worker=False + attach + start) — with it off,
+    the dispatcher's locks stay plain threading primitives."""
+    import pathlib
     import threading
+
+    from esac_tpu.lint.lockgraph import LOCK_GRAPH_NAME, load_graph
+    from esac_tpu.lint.witness import LockWitness
 
     def fake_infer(tree, scene=None, route_k=None):
         return {"echo": tree["x"]}
 
     cfg = dataclasses.replace(CFG, frame_buckets=(1, 4),
                               serve_max_wait_ms=1.0, serve_queue_depth=64)
-    disp = MicroBatchDispatcher(fake_infer, cfg, start_worker=True,
+    disp = MicroBatchDispatcher(fake_infer, cfg, start_worker=False,
                                 stats_window=64)
+    # Warm both scene lanes through the sync path FIRST so the lane
+    # histogram children exist when the witness wraps the obs
+    # instruments (children born later are simply unobserved — the
+    # subgraph check is one-sided, but the edge coverage is better with
+    # them wrapped).
+    for tid in range(2):
+        disp.infer_one({"x": np.full(2, -1.0, np.float32)},
+                       scene=f"s{tid}")
+    witness = LockWitness().attach_fleet(disp=disp)
+    disp.start()
     n_callers, n_each = 4, 100
     errors: list[Exception] = []
     done = threading.Event()
@@ -322,10 +343,24 @@ def test_heavy_dispatcher_concurrent_infer_one_and_stats_reads():
     # Coalescing makes dispatches <= requests; every request was answered
     # (asserted per caller above) and the lane table drained.
     totals = disp.dispatch_totals()
-    assert 0 < sum(totals.values()) <= n_callers * n_each
+    assert 0 < sum(totals.values()) <= n_callers * n_each + 2
     assert set(totals) == {("s0", None), ("s1", None)}
     assert len(disp.dispatch_log) <= 64
     assert not disp._pending and disp._n_pending == 0
+    # graft-audit v3: the edges this stress ACTUALLY took are a subgraph
+    # of the committed lock order, the accounting publish really did
+    # nest under the dispatch lock (edge observed, not just modeled),
+    # and hold times landed in the witness histograms.
+    committed = load_graph(
+        pathlib.Path(__file__).resolve().parent.parent / LOCK_GRAPH_NAME
+    )
+    assert committed is not None, "no committed .lock_graph.json"
+    witness.assert_subgraph(committed)
+    observed = witness.edges()
+    assert any(src == "MicroBatchDispatcher._lock"
+               for (src, _dst) in observed), observed
+    holds = witness.hold_summary()
+    assert holds["MicroBatchDispatcher._lock"]["count"] > 0
 
 
 # ---------------- heavy legs: excluded from tier-1 ----------------
