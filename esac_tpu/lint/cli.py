@@ -7,14 +7,20 @@ Modes
 -----
 - default           : layer 1 over the full tree (incl. the graft-audit v3
                       R12/R13 fleet concurrency analysis + the lock-graph
-                      diff vs the committed .lock_graph.json) + layer 2
-                      (jaxpr audit + resource-ledger diff vs the committed
-                      .jaxpr_ledger.json); full-tree runs also sweep for
-                      stale inline suppressions and stale R11 waivers
+                      diff vs the committed .lock_graph.json, and the
+                      graft-audit v4 R14/R15 grad-safety dataflow pass
+                      over the differentiated geometry/ransac/train
+                      scope) + layer 2 (jaxpr audit + resource-ledger
+                      diff vs the committed .jaxpr_ledger.json, incl. the
+                      J5 backward-jaxpr grad-hazard census); full-tree
+                      runs also sweep for stale inline suppressions and
+                      stale R11 waivers
 - ``--changed``     : layer 1 over git-modified/untracked files only; the
                       jaxpr audit AND the ledger run only when a traced
-                      package file changed, and the lock-graph pass only
-                      when a serve/registry/obs/lint file changed (fast
+                      package file changed, the lock-graph pass only
+                      when a serve/registry/obs/lint file changed, and
+                      the grad-safety pass only when a
+                      geometry/ransac/train/lint file changed (fast
                       pre-commit mode)
 - ``PATHS…``        : layer 1 over the given files/dirs; layer 2 only when
                       they include package (esac_tpu/) files

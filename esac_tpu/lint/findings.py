@@ -154,6 +154,24 @@ RULES = {
         "coalescing Condition.wait that RELEASES the held lock is the "
         "one allowlisted idiom",
     ),
+    "R14": (
+        "unguarded domain-edge primitive in differentiated scope "
+        "(eps-free division, unclamped arccos/arcsin, log/fractional-pow "
+        "of maybe-zero)",
+        "LINT.md graft-audit v4 / CLAUDE.md code conventions: geometry is "
+        "total + grad-safe at EVERY input — a single degenerate sample's "
+        "NaN backward value poisons the whole vmapped batch gradient; "
+        "guard the operand (eps-add, jnp.maximum floor, select-clamp, "
+        "safe_norm/safe_sqrt), never the forward value alone",
+    ),
+    "R15": (
+        "NaN-hazard expression inside a jnp.where/lax.select branch "
+        "(the where-VJP trap) in differentiated scope",
+        "LINT.md graft-audit v4: where does not stop NaNs from the "
+        "untaken branch's VJP (0 * inf = NaN) — the documented trap the "
+        "safe_norm/safe_sqrt/select-clamp idioms exist to avoid; guard "
+        "the OPERAND (x / where(bad, 1.0, d)), not the result",
+    ),
     # Layer-2 (jaxpr auditor) finding ids, reported with path = the
     # registry entry name:
     "J1": (
@@ -179,5 +197,15 @@ RULES = {
         "bytes / dot-precision census are committed numbers — growth "
         "beyond tolerance, a dropped HIGHEST pin, or an unledgered entry "
         "fails; regenerate with --write-ledger and review the diff",
+    ),
+    "J5": (
+        "backward-jaxpr grad-hazard census regression vs the committed "
+        ".jaxpr_ledger.json (new unguarded domain-edge site)",
+        "LINT.md graft-audit v4: every grad-registered entry's traced "
+        "backward is walked for domain-edge primitives (div, rsqrt, pow, "
+        "log, acos, asin, atan2) keyed by whether an eps-add/floor/clamp "
+        "dominates the vulnerable operand; the counts are committed — an "
+        "unreviewed NEW unguarded site fails, improvements report stale "
+        "(--write-ledger + review, the J4 workflow)",
     ),
 }

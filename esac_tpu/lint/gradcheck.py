@@ -1,0 +1,500 @@
+"""The degenerate-input gradient witness (graft-audit v4, runtime half).
+
+The static layers argue a NaN *cannot* be emitted (R14/R15 over the
+source, the J5 census over the backward jaxprs); this module *runs* the
+contract: every grad-registered entry point is evaluated with
+``jax.value_and_grad`` on forced-CPU against a committed corpus of
+degenerate inputs — collinear and coincident P3P sets, zero-length rays,
+zero-depth cells, identity and pi rotations, all-equal scores forcing
+selection ties, and the all-dropped routed frame — asserting that every
+output AND every gradient is finite.  This is the CLAUDE.md convention
+("degenerate inputs produce finite garbage + a penalty, never control
+flow") made executable, and the rail ROADMAP item 5 (closed-loop fleet
+learning: gradients on the serving path) requires before it can land.
+
+Design notes:
+
+- **One compiled program per witness.**  Every corpus case shares the
+  same tiny shapes (16 cells, 4 hypotheses, 2 experts), so each witness
+  compiles once and the whole corpus replays through the cached program —
+  the reason the witness rides tier-1 un-slow-marked.
+- **The corpus is committed** (``.grad_corpus.json``) with plain-float
+  JSON arrays (exact round-trip), and ``default_corpus()`` must match it
+  exactly — a corpus edit is a reviewed diff, like the ledger.
+- **Witness coverage is pinned**: tests assert the witness set covers
+  exactly the ``grad=True`` registry entries (plus the routed drop-mask
+  witness, whose -inf score output is a *designed* failure signal and is
+  therefore excluded from its finiteness checks — only the pose and its
+  gradients are asserted there).
+- Forced CPU before any device use, like every lint layer (CLAUDE.md
+  environment hazards).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+GRAD_CORPUS_NAME = ".grad_corpus.json"
+
+N_CELLS = 16
+N_HYPS = 4
+N_EXPERTS = 2
+
+_PI = 3.141592653589793
+
+
+def _force_cpu() -> None:
+    # One force-CPU mechanism for the whole lint package (jaxpr_audit owns
+    # the why: the env var is overridden by the container sitecustomize;
+    # only the post-import config update sticks).  The witness does not
+    # need the 8-device mesh, but sharing the helper keeps the guarantee
+    # in one place.
+    from esac_tpu.lint.jaxpr_audit import _force_cpu as _audit_force_cpu
+
+    _audit_force_cpu()
+
+
+# --------------------------------------------------------------------------
+# the corpus
+
+def _grid_coords() -> list:
+    """Deterministic well-posed scene points (plain floats: exact JSON)."""
+    return [
+        [((i * 7) % N_CELLS) / 8.0 - 1.0,
+         ((i * 5) % N_CELLS) / 8.0 - 1.0,
+         1.5 + (i % 4) * 0.25]
+        for i in range(N_CELLS)
+    ]
+
+
+def _grid_pixels() -> list:
+    return [[(i % 4) * 16.0 + 8.0, (i // 4) * 12.0 + 6.0]
+            for i in range(N_CELLS)]
+
+
+def default_corpus() -> dict:
+    """The canonical degenerate-input corpus.  Every case shares shapes
+    (coords (16, 3), pixels (16, 2), scalar f, c (2,), rvec/tvec (3,))
+    so each witness compiles exactly once across the whole corpus."""
+    base = {
+        "f": 60.0, "c": [32.0, 24.0],
+        "rvec": [0.1, -0.05, 0.02], "tvec": [0.0, 0.0, 2.0],
+        "tie_hypotheses": False, "kept": [True, True],
+    }
+    cases = {
+        "collinear_p3p_triad": {
+            **base,
+            "description": "every sampled minimal set is collinear: the "
+                           "triad frame's cross products vanish and the "
+                           "P3P side lengths degenerate (penalty-branch "
+                           "territory, SURVEY.md retry-on-bad-sample)",
+            "coords": [[i * 0.1, i * 0.05, 1.0 + i * 0.02]
+                       for i in range(N_CELLS)],
+            "pixels": _grid_pixels(),
+        },
+        "coincident_points": {
+            **base,
+            "description": "all scene points AND all pixels identical: "
+                           "zero difference vectors, zero norms, an "
+                           "all-zero quartic, and every hypothesis "
+                           "scoring exactly equal",
+            "coords": [[0.5, -0.25, 1.0]] * N_CELLS,
+            "pixels": [[32.0, 24.0]] * N_CELLS,
+        },
+        "zero_rays": {
+            **base,
+            "description": "every pixel sits exactly on the principal "
+                           "point: bearing xy components are exactly 0 "
+                           "(the safe_norm-guarded ray normalization's "
+                           "edge)",
+            "coords": _grid_coords(),
+            "pixels": [[32.0, 24.0]] * N_CELLS,
+        },
+        "zero_depth_cells": {
+            **base,
+            "description": "scene points on the camera plane (z = 0 at "
+                           "the identity pose): the MIN_DEPTH clamp and "
+                           "the behind-camera penalty branch carry both "
+                           "passes",
+            "coords": [[((i * 7) % N_CELLS) / 8.0 - 1.0,
+                        ((i * 5) % N_CELLS) / 8.0 - 1.0, 0.0]
+                       for i in range(N_CELLS)],
+            "pixels": _grid_pixels(),
+            "rvec": [0.0, 0.0, 0.0], "tvec": [0.0, 0.0, 0.0],
+        },
+        "identity_rotation": {
+            **base,
+            "description": "exact-identity rotation: so3_log's theta -> 0 "
+                           "limit and the small-angle Taylor blends, in "
+                           "both passes",
+            "coords": _grid_coords(),
+            "pixels": _grid_pixels(),
+            "rvec": [0.0, 0.0, 0.0],
+        },
+        "pi_rotation": {
+            **base,
+            "description": "rotation by exactly pi: so3_log's near-pi "
+                           "outer-product branch with the skew part "
+                           "exactly zero",
+            "coords": _grid_coords(),
+            "pixels": _grid_pixels(),
+            "rvec": [_PI, 0.0, 0.0],
+        },
+        "tie_scores": {
+            **base,
+            "description": "all hypotheses identical (zero per-hypothesis "
+                           "offsets): every score exactly equal, forcing "
+                           "the argmax/streamed-select tie-break and a "
+                           "flat selection softmax",
+            "coords": _grid_coords(),
+            "pixels": _grid_pixels(),
+            "tie_hypotheses": True,
+        },
+        "all_dropped_routed": {
+            **base,
+            "description": "every routed slot capacity-dropped (kept all "
+                           "False): the -inf score masking is the "
+                           "DESIGNED failure signal, and the pose must "
+                           "still be finite garbage with finite gradients",
+            "coords": _grid_coords(),
+            "pixels": _grid_pixels(),
+            "kept": [False, False],
+        },
+    }
+    return {
+        "comment": "graft-audit v4 degenerate-input gradient corpus; see "
+                   "LINT.md.  Every grad-registered entry must produce "
+                   "all-finite outputs AND gradients on every case "
+                   "(tests/test_gradsafety.py).  Regenerate only via "
+                   "lint/gradcheck.py default_corpus() and review the "
+                   "diff — a removed case un-pins a degeneracy class.",
+        "cases": cases,
+    }
+
+
+def write_corpus(path: pathlib.Path, corpus: dict | None = None) -> None:
+    corpus = corpus or default_corpus()
+    path.write_text(json.dumps(corpus, indent=2, sort_keys=True) + "\n")
+
+
+def load_corpus(path: pathlib.Path) -> dict | None:
+    if not path.exists():
+        return None
+    return json.loads(path.read_text())
+
+
+# --------------------------------------------------------------------------
+# finiteness checking
+
+def tree_all_finite(tree) -> bool:
+    """Every float leaf finite (bool/int leaves are vacuously finite)."""
+    import jax
+    import numpy as np
+
+    for leaf in jax.tree_util.tree_leaves(tree):
+        arr = np.asarray(leaf)
+        if arr.dtype.kind in "fc" and not np.all(np.isfinite(arr)):
+            return False
+    return True
+
+
+def check_case(fn, arrays: dict) -> dict:
+    """Run one compiled witness on one corpus case -> verdict record.
+    Shared by :func:`run_gradcheck` and the planted-NaN fixture test (the
+    proof the witness CATCHES a violation rides the same code path)."""
+    outputs, grads = fn(**arrays)
+    return {
+        "outputs_finite": tree_all_finite(outputs),
+        "grads_finite": tree_all_finite(grads),
+    }
+
+
+def _case_arrays(case: dict) -> dict:
+    import jax.numpy as jnp
+    import numpy as np
+
+    offs = np.zeros((N_HYPS, 3), np.float32)
+    if not case.get("tie_hypotheses", False):
+        # Fixed, deterministic per-hypothesis pose offsets: distinct
+        # hypotheses in the generic cases, all-equal when the case forces
+        # ties.
+        offs = np.asarray(
+            [[0.0, 0.0, 0.0], [0.02, -0.01, 0.005],
+             [-0.03, 0.015, 0.0], [0.01, 0.02, -0.01]], np.float32
+        )
+    return {
+        "coords": jnp.asarray(case["coords"], jnp.float32),
+        "pixels": jnp.asarray(case["pixels"], jnp.float32),
+        "f": jnp.float32(case["f"]),
+        "c": jnp.asarray(case["c"], jnp.float32),
+        "rvec": jnp.asarray(case["rvec"], jnp.float32),
+        "tvec": jnp.asarray(case["tvec"], jnp.float32),
+        "offs": jnp.asarray(offs),
+        "kept": jnp.asarray(case.get("kept", [True, True])),
+    }
+
+
+# --------------------------------------------------------------------------
+# witnesses: one per grad-registered entry (+ the routed drop-mask leg)
+
+def _make_pnp_minimal_grad():
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.geometry.pnp import solve_pnp_minimal
+
+    @jax.jit
+    def run(coords, pixels, f, c, rvec, tvec, offs, kept):
+        X4, x4 = coords[:4], pixels[:4]
+
+        def loss(X4, x4):
+            rv, tv = solve_pnp_minimal(X4, x4, f, c, polish_iters=1)
+            return jnp.sum(rv) + jnp.sum(tv), (rv, tv)
+
+        (val, (rv, tv)), grads = jax.value_and_grad(
+            loss, argnums=(0, 1), has_aux=True
+        )(X4, x4)
+        return {"rvec": rv, "tvec": tv, "loss": val}, grads
+
+    return run
+
+
+def _make_refine_soft_inliers_grad():
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.ransac.refine import refine_soft_inliers
+
+    @jax.jit
+    def run(coords, pixels, f, c, rvec, tvec, offs, kept):
+        def loss(coords, rvec, tvec):
+            rv, tv = refine_soft_inliers(
+                rvec, tvec, coords, pixels, f, c, tau=10.0, beta=0.5,
+                iters=2,
+            )
+            return jnp.sum(rv) + jnp.sum(tv), (rv, tv)
+
+        (val, (rv, tv)), grads = jax.value_and_grad(
+            loss, argnums=(0, 1, 2), has_aux=True
+        )(coords, rvec, tvec)
+        return {"rvec": rv, "tvec": tv, "loss": val}, grads
+
+    return run
+
+
+def _make_dsac_train_loss_grad():
+    import jax
+
+    from esac_tpu.ransac.config import RansacConfig
+    from esac_tpu.ransac.kernel import dsac_train_loss
+
+    cfg = RansacConfig(n_hyps=N_HYPS, train_refine_iters=1, polish_iters=1)
+
+    @jax.jit
+    def run(coords, pixels, f, c, rvec, tvec, offs, kept):
+        from esac_tpu.geometry.rotations import rodrigues
+
+        key = jax.random.key(0)
+        R_gt = rodrigues(rvec)
+
+        def loss(coords):
+            val, aux = dsac_train_loss(
+                key, coords, pixels, f, c, R_gt, tvec, cfg
+            )
+            return val, aux
+
+        (val, aux), g = jax.value_and_grad(loss, has_aux=True)(coords)
+        return {"loss": val, "scores": aux["scores"],
+                "probs": aux["selection_probs"]}, {"coords": g}
+
+    return run
+
+
+def _make_scoring_grad(impl: str):
+    def make():
+        import jax
+        import jax.numpy as jnp
+
+        from esac_tpu.ransac.config import RansacConfig
+        from esac_tpu.ransac.kernel import _score_hypotheses
+
+        cfg = RansacConfig(n_hyps=N_HYPS, scoring_impl=impl, score_chunk=2)
+
+        @jax.jit
+        def run(coords, pixels, f, c, rvec, tvec, offs, kept):
+            key = jax.random.key(1)
+            rvecs = rvec[None, :] + offs
+            tvecs = jnp.tile(tvec, (N_HYPS, 1))
+
+            def loss(coords, rvecs, tvecs):
+                scores = _score_hypotheses(
+                    key, rvecs, tvecs, coords, pixels, f, c, cfg
+                )
+                return jnp.sum(scores), scores
+
+            (val, scores), grads = jax.value_and_grad(
+                loss, argnums=(0, 1, 2), has_aux=True
+            )(coords, rvecs, tvecs)
+            return {"loss": val, "scores": scores}, grads
+
+        return run
+
+    return make
+
+
+def _make_scoring_fused_select_grad():
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.ransac.pallas_scoring import soft_inlier_score_select
+
+    @jax.jit
+    def run(coords, pixels, f, c, rvec, tvec, offs, kept):
+        from esac_tpu.geometry.rotations import rodrigues
+
+        rvecs = rvec[None, :] + offs
+        tvecs = jnp.tile(tvec, (N_HYPS, 1))
+
+        def loss(coords, rvecs, tvecs):
+            Rs = jax.vmap(rodrigues)(rvecs)
+            best_i, best_s = soft_inlier_score_select(
+                Rs, tvecs, coords, pixels, f, c, 10.0, 0.5,
+                use_pallas=False, chunk=2,
+            )
+            return best_s, best_i
+
+        (best_s, best_i), grads = jax.value_and_grad(
+            loss, argnums=(0, 1, 2), has_aux=True
+        )(coords, rvecs, tvecs)
+        return {"best_score": best_s, "best_idx": best_i}, grads
+
+    return run
+
+
+def _make_esac_train_loss_dense_grad():
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.ransac.config import RansacConfig
+    from esac_tpu.ransac.esac import esac_train_loss
+
+    cfg = RansacConfig(n_hyps=N_HYPS, train_refine_iters=1, polish_iters=1)
+
+    @jax.jit
+    def run(coords, pixels, f, c, rvec, tvec, offs, kept):
+        from esac_tpu.geometry.rotations import rodrigues
+
+        key = jax.random.key(2)
+        R_gt = rodrigues(rvec)
+        # Two experts sharing the SAME degenerate map: the cross-expert
+        # selection ties exactly like the within-expert ones.
+        coords_all = jnp.stack([coords, coords])
+        logits = jnp.zeros((N_EXPERTS,))
+
+        def loss(coords_all, logits):
+            val, aux = esac_train_loss(
+                key, logits, coords_all, pixels, f, c, R_gt, tvec, cfg,
+                "dense",
+            )
+            return val, aux
+
+        (val, aux), grads = jax.value_and_grad(
+            loss, argnums=(0, 1), has_aux=True
+        )(coords_all, logits)
+        return {"loss": val, "per_expert_loss": aux["per_expert_loss"],
+                "gating_probs": aux["gating_probs"]}, grads
+
+    return run
+
+
+def _make_routed_drop_mask():
+    """The all-dropped-routed leg: NOT a grad-registered entry, but the
+    corpus's routed case needs a consumer.  Only the POSE and its
+    gradients are asserted finite — the -inf winner score of an
+    all-dropped frame is the documented failure signal, not a violation
+    (ransac/esac.esac_infer_routed_frames docstring)."""
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.ransac.config import RansacConfig
+    from esac_tpu.ransac.esac import esac_infer_routed_frames
+
+    cfg = RansacConfig(n_hyps=2, refine_iters=1, polish_iters=1,
+                       score_chunk=2)
+    # M = K = 2 keeps the compiled program minimal; esac_infer_routed_frames
+    # is ONE code path regardless of K vs M, and the drop-mask semantics
+    # under test (-inf masking, slot-0 fallback, finite pose + grads) are
+    # K-independent.
+    M, K = 2, 2
+
+    @jax.jit
+    def run(coords, pixels, f, c, rvec, tvec, offs, kept):
+        keys = jax.random.split(jax.random.key(3), 1)
+        logits = jnp.zeros((1, M))
+        selected = jnp.asarray([[0, 1]], jnp.int32)
+        kept_B = kept[None, :]
+        pixels_B = pixels[None]
+        f_B = f[None]
+
+        def loss(coords_sel):
+            out = esac_infer_routed_frames(
+                keys, logits, coords_sel, selected, kept_B, pixels_B,
+                f_B, c, cfg,
+            )
+            return jnp.sum(out["rvec"]) + jnp.sum(out["tvec"]), out
+
+        coords_sel = jnp.stack([coords, coords + 0.1])[None]  # (1, K, N, 3)
+        (val, out), g = jax.value_and_grad(loss, has_aux=True)(coords_sel)
+        return {"rvec": out["rvec"], "tvec": out["tvec"],
+                "loss": val}, {"coords_sel": g}
+
+    return run
+
+
+# Witness registry: name -> builder of one jitted run(case arrays) fn.
+# The names `*_grad` must cover EXACTLY the grad=True registry entries
+# (pinned by tests/test_gradsafety.py); `routed_drop_mask` is the extra
+# leg the all_dropped_routed corpus case exists for.
+WITNESSES: dict = {
+    "pnp_minimal_grad": _make_pnp_minimal_grad,
+    "refine_soft_inliers_grad": _make_refine_soft_inliers_grad,
+    "dsac_train_loss_grad": _make_dsac_train_loss_grad,
+    "scoring_errmap_grad": _make_scoring_grad("errmap"),
+    "scoring_fused_grad": _make_scoring_grad("fused"),
+    "scoring_fused_select_train_grad": _make_scoring_grad("fused_select"),
+    "scoring_fused_select_grad": _make_scoring_fused_select_grad,
+    "esac_train_loss_dense_grad": _make_esac_train_loss_dense_grad,
+    "routed_drop_mask": _make_routed_drop_mask,
+}
+
+
+def run_gradcheck(corpus: dict | None = None,
+                  witnesses: dict | None = None) -> dict:
+    """Evaluate every witness against every corpus case on forced CPU.
+
+    Returns the per-entry verdict block::
+
+        {entry: {case: {"outputs_finite": bool, "grads_finite": bool}},
+         ...,
+         "clean": bool}
+
+    One compiled program per witness (cases share shapes), so the whole
+    sweep is tier-1-cheap.
+    """
+    _force_cpu()
+    if corpus is None:
+        corpus = default_corpus()
+    witnesses = witnesses if witnesses is not None else WITNESSES
+    verdicts: dict = {}
+    clean = True
+    for name, make in witnesses.items():
+        fn = make()
+        per_case: dict = {}
+        for case_name, case in sorted(corpus["cases"].items()):
+            v = check_case(fn, _case_arrays(case))
+            per_case[case_name] = v
+            clean = clean and v["outputs_finite"] and v["grads_finite"]
+        verdicts[name] = per_case
+    verdicts["clean"] = clean
+    return verdicts

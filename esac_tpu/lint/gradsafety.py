@@ -1,0 +1,622 @@
+"""Layer 1d, R14/R15: grad-safety dataflow analysis (graft-audit v4).
+
+R2 is the only CLAUDE.md code convention that was still enforced purely
+syntactically: it flags the *spellings* ``jnp.linalg.norm`` / bare
+``jnp.sqrt``, but nothing verified that a backward pass cannot emit NaN
+through the documented ``where``-VJP trap, an unguarded division, or a
+trig/log/pow primitive at its domain edge.  This pass closes that gap with
+a dataflow analysis over the differentiated packages
+(``esac_tpu/{geometry,ransac,train}/``):
+
+- **Differentiated scope** — the call graph reachable from the
+  *grad-registered* entry points: the ``grad=True`` registry entries
+  (their builders are parsed out of ``lint/registry.py``, so the root set
+  stays in sync with the jaxpr audit by construction) plus every function
+  fed to a ``jax.grad``/``value_and_grad``/``custom_vjp``/``defvjp``/
+  ``jax.vjp`` wrapper inside the scope packages themselves (the Pallas
+  custom-VJP forward/backward pairs).  Reachability propagates through the
+  R3-style intra-package call graph; nested defs and lambdas inside a
+  reachable function are scanned with it (a closure built in a
+  differentiated function is differentiated).
+- **Guardedness** — a value is *guarded* (bounded away from its domain
+  edge in both passes) when it flows from ``safe_norm``/``safe_sqrt``, an
+  eps-add (``x + 1e-9``, ``x + _EPS``), a floor (``jnp.maximum(x, k)``
+  with a constant), the select-clamp idiom (``jnp.where(bad, floor, x)``
+  — the author explicitly handled the edge; R15 separately polices the
+  *misuse* of ``where``), ``exp``, a static shape (``x.shape[0]``), an
+  ``int``/``bool``-annotated parameter (static under jit — no VJP
+  exists), or a parameter with a nonzero numeric default.  One level of
+  helper propagation: a call to a same-package function whose return
+  expression is guard-shaped is guarded (the ``lead_safe`` idiom in
+  geometry/quartic.py).  Anything unresolvable is *unguarded* — this rule
+  deliberately over-approximates hazards (the opposite contract from
+  R3/R8): a missed NaN poisons a whole batch gradient, a false positive
+  costs one reviewed suppression.
+- **R14** — an unguarded domain-edge primitive in differentiated scope:
+  ``x / y`` with an unguarded denominator, ``arccos``/``arcsin`` without
+  a clamp (``jnp.clip`` / min-max chain / a [-1,1]-bounded producer like
+  ``cos``) dominating the input, ``log`` of a maybe-zero value, or a
+  fractional/negative power of a maybe-zero base (integer powers >= 1
+  are total).  ``log1p`` and ``sqrt`` are NOT R14's: ``log1p`` is total
+  at 0 and sqrt is R2's (one rule per spelling).
+- **R15** — the documented ``where``-VJP trap: an R14-style hazard
+  expression **inside either branch** of a ``jnp.where``/``lax.select``.
+  The forward value is masked; the untaken branch's VJP still runs and
+  ``0 * inf = NaN`` poisons the batch gradient (utils/num.py docstring;
+  CLAUDE.md conventions).  The sanctioned idiom — guarding the *operand*
+  (``x / jnp.where(bad, 1.0, d)``) or an eps/const-guarded hazard inside
+  the branch — classifies as a near-miss.
+
+Pure stdlib ``ast`` — no jax import; rides ``run_layer1`` and therefore
+the same suppression (``# graft-lint: disable=R14(reason)``), baseline,
+``--format json`` and stale-sweep machinery as every other rule.  The
+runtime half is :mod:`esac_tpu.lint.gradcheck` (the degenerate-input
+gradient witness) and the J5 backward-jaxpr hazard census in
+:mod:`esac_tpu.lint.ledger`.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from esac_tpu.lint.ast_rules import (
+    _Module,
+    _alias_map,
+    _callees,
+    _dotted,
+    _line_text,
+    _resolve_function,
+    iter_python_files,
+)
+from esac_tpu.lint.findings import Finding
+from esac_tpu.lint.suppress import is_suppressed, parse_suppressions
+
+# The differentiated packages the pass analyzes...
+GRAD_SCOPE_PREFIXES = (
+    "esac_tpu/geometry/", "esac_tpu/ransac/", "esac_tpu/train/",
+)
+# ...and what triggers the pass in --changed mode (editing the analysis
+# itself must re-run it, the lock-pass convention).
+PASS_PREFIXES = GRAD_SCOPE_PREFIXES + ("esac_tpu/lint/",)
+
+
+def grad_pass_needed(files) -> bool:
+    """Mirror of lockgraph.lock_pass_needed: full runs always analyze;
+    scoped runs only when a geometry/ransac/train or lint file changed."""
+    if files is None:
+        return True
+    return any(
+        f.startswith(PASS_PREFIXES) and f.endswith(".py") for f in files
+    )
+
+
+# Wrappers whose function argument enters differentiated scope.
+_GRAD_WRAPPERS = {
+    "jax.grad", "jax.value_and_grad", "jax.vjp", "jax.jvp", "jax.linearize",
+    "jax.jacobian", "jax.jacfwd", "jax.jacrev",
+    "jax.custom_vjp", "jax.custom_jvp",
+}
+
+# Callable names (trailing attribute) treated as guard producers.
+_SAFE_CALLS = {"safe_norm", "safe_sqrt"}
+# where/select produce the select-clamp idiom; exp is strictly positive.
+_SELECT_CALLS = {"where", "select"}
+# Producers whose RANGE is within [-1, 1] (arccos/arcsin domination).
+_BOUNDED_CALLS = {"cos", "sin", "tanh"}
+
+_MAX_DEPTH = 12
+
+
+def _is_eps_name(name: str) -> bool:
+    """Names that denote a numeric guard constant by convention: anything
+    containing 'eps', or an ALL-CAPS module constant (MIN_DEPTH, _EPS)."""
+    bare = name.lstrip("_")
+    return "eps" in name.lower() or (bare.isupper() and bare != "")
+
+
+def _const_like(node: ast.AST) -> bool:
+    """Nonzero numeric literal, eps-named constant, or a negation of one."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float, complex)) and node.value != 0
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return _const_like(node.operand)
+    name = None
+    if isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Attribute):
+        name = node.attr
+    return name is not None and _is_eps_name(name)
+
+
+class _Scope:
+    """Per-function analysis scope: flow-ordered assignments, parameters
+    (with annotations/defaults), and the owning module for helper and
+    module-constant resolution."""
+
+    def __init__(self, mod: _Module, fn: ast.AST):
+        self.mod = mod
+        self.fn = fn
+        # name -> [(lineno, value expr)], flow-ordered single-target binds.
+        self.assigns: dict[str, list[tuple[int, ast.AST]]] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                self.assigns.setdefault(node.targets[0].id, []).append(
+                    (node.lineno, node.value)
+                )
+        for binds in self.assigns.values():
+            binds.sort()
+        # Parameters of the scanned function (nested-def params stay
+        # unresolved -> tainted, the conservative direction).
+        self.params: dict[str, tuple[ast.AST | None, ast.AST | None]] = {}
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = fn.args
+            all_args = args.posonlyargs + args.args + args.kwonlyargs
+            defaults = [None] * (
+                len(args.posonlyargs) + len(args.args) - len(args.defaults)
+            ) + list(args.defaults)
+            defaults += list(args.kw_defaults)
+            for a, d in zip(all_args, defaults):
+                self.params[a.arg] = (a.annotation, d)
+
+    def latest_bind(self, name: str, before: int) -> ast.AST | None:
+        binds = self.assigns.get(name)
+        if not binds:
+            return None
+        prior = [v for ln, v in binds if ln <= before]
+        return prior[-1] if prior else binds[-1][1]
+
+
+def _param_guarded(scope: _Scope, name: str) -> bool | None:
+    """None = not a parameter; else its guardedness: int/bool annotation
+    (static under jit, no VJP) or a nonzero numeric default."""
+    if name not in scope.params:
+        return None
+    ann, default = scope.params[name]
+    if isinstance(ann, ast.Name) and ann.id in ("int", "bool"):
+        return True
+    if isinstance(default, ast.Constant) and \
+            isinstance(default.value, (int, float)) and default.value != 0:
+        return True
+    return False
+
+
+def _helper_return_guarded(scope: _Scope, fname: str, depth: int) -> bool | None:
+    """One level of helper propagation: a same-module function whose every
+    return expression is guarded makes its call results guarded (the
+    geometry/quartic.py ``lead_safe`` idiom).  None = not resolvable."""
+    helper = scope.mod.functions.get(fname)
+    if helper is None or depth > _MAX_DEPTH:
+        return None
+    returns = [
+        n.value for n in ast.walk(helper)
+        if isinstance(n, ast.Return) and n.value is not None
+    ]
+    if not returns:
+        return None
+    hscope = _Scope(scope.mod, helper)
+    return all(
+        _guarded(hscope, r, use_line=getattr(r, "lineno", 0),
+                 depth=depth + 1)
+        for r in returns
+    )
+
+
+def _guarded(scope: _Scope, node: ast.AST, use_line: int, depth: int = 0,
+             _seen: frozenset = frozenset()) -> bool:
+    """Is this expression's value bounded away from the domain edge in
+    BOTH passes?  False whenever unresolvable (hazards over-approximate)."""
+    if depth > _MAX_DEPTH:
+        return False
+    if _const_like(node):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return _guarded(scope, node.operand, use_line, depth + 1, _seen)
+    if isinstance(node, ast.IfExp):
+        return (
+            _guarded(scope, node.body, use_line, depth + 1, _seen)
+            and _guarded(scope, node.orelse, use_line, depth + 1, _seen)
+        )
+    if isinstance(node, ast.BinOp):
+        if isinstance(node.op, ast.Add):
+            # x + eps (either side): the canonical guard.
+            return _const_like(node.left) or _const_like(node.right)
+        if isinstance(node.op, (ast.Mult, ast.Div)):
+            # nonzero * nonzero (const * where(...) etc.) stays nonzero.
+            return (
+                _guarded(scope, node.left, use_line, depth + 1, _seen)
+                and _guarded(scope, node.right, use_line, depth + 1, _seen)
+            )
+        return False
+    if isinstance(node, ast.Subscript):
+        # Static shapes are nonzero ints; slicing a guarded array keeps the
+        # elementwise floor.
+        if isinstance(node.value, ast.Attribute) and node.value.attr == "shape":
+            return True
+        return _guarded(scope, node.value, use_line, depth + 1, _seen)
+    if isinstance(node, ast.Call):
+        dotted = _dotted(node.func, scope.mod.aliases) or ""
+        tail = dotted.rpartition(".")[2]
+        if tail in _SAFE_CALLS:
+            return True
+        if tail in _SELECT_CALLS:
+            # The select-clamp idiom: jnp.where(bad, floor, x).  Whether the
+            # clamp is CORRECT is the runtime witness's job (gradcheck); the
+            # static rule credits the author with handling the edge.
+            return True
+        if tail == "exp":
+            return True
+        if tail in ("maximum", "clip", "clamp"):
+            # A floor needs a constant bound: maximum(x, 1e-9) or
+            # maximum(x, MIN_DEPTH).  maximum(x, y) of two tainted values
+            # floors nothing.
+            return any(_const_like(a) for a in node.args[1:]) or \
+                any(_const_like(kw.value) for kw in node.keywords)
+        if tail in ("float32", "float64", "asarray", "astype"):
+            return bool(node.args) and _guarded(
+                scope, node.args[0], use_line, depth + 1, _seen
+            )
+        if isinstance(node.func, ast.Name):
+            helper = _helper_return_guarded(scope, node.func.id, depth)
+            if helper is not None:
+                return helper
+        return False
+    if isinstance(node, ast.Attribute):
+        return _is_eps_name(node.attr)
+    if isinstance(node, ast.Name):
+        if node.id in _seen:
+            return False  # self-referential rebinding chain
+        p = _param_guarded(scope, node.id)
+        if p is not None:
+            return p
+        if _is_eps_name(node.id):
+            return True
+        bind = scope.latest_bind(node.id, use_line)
+        if bind is not None:
+            return _guarded(scope, bind, getattr(bind, "lineno", use_line),
+                            depth + 1, _seen | {node.id})
+        # Fall back to a module-level constant binding.
+        for stmt in scope.mod.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and stmt.targets[0].id == node.id:
+                mscope = _Scope(scope.mod, scope.mod.tree)
+                return _guarded(mscope, stmt.value, stmt.lineno, depth + 1,
+                                _seen | {node.id})
+        return False
+    return False
+
+
+def _const_value(node: ast.AST) -> float | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)):
+        return float(node.value)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = _const_value(node.operand)
+        return None if inner is None else -inner
+    return None
+
+
+def _bounded(scope: _Scope, node: ast.AST, use_line: int, need: str,
+             depth: int = 0) -> bool:
+    """Is this expression provably bounded on one side of the arccos
+    domain — ``need='lo'`` (value >= -1) or ``need='hi'`` (value <= 1)?
+
+    Real interval reasoning, not clamp-spotting: ``maximum(x, c)`` bounds
+    BELOW if either operand does but ABOVE only if both do, ``minimum``
+    mirrors, and ``clip``'s literal bounds must actually sit inside
+    [-1, 1] — ``clip(x, -2, 2)`` or a floor-only ``maximum(x, -1)``
+    leaves the hazard live and must NOT silence it (this pass
+    over-approximates hazards)."""
+    if depth > _MAX_DEPTH:
+        return False
+    c = _const_value(node)
+    if c is not None:
+        return c >= -1.0 if need == "lo" else c <= 1.0
+    if isinstance(node, ast.Call):
+        tail = (_dotted(node.func, scope.mod.aliases) or "").rpartition(".")[2]
+        if tail in _BOUNDED_CALLS:
+            return True
+        if tail == "clip" and len(node.args) >= 3:
+            bound = node.args[1] if need == "lo" else node.args[2]
+            bc = _const_value(bound)
+            return bc is not None and (
+                bc >= -1.0 if need == "lo" else bc <= 1.0
+            )
+        if tail == "maximum" and node.args:
+            check = any if need == "lo" else all
+            return check(
+                _bounded(scope, a, use_line, need, depth + 1)
+                for a in node.args
+            )
+        if tail == "minimum" and node.args:
+            check = all if need == "lo" else any
+            return check(
+                _bounded(scope, a, use_line, need, depth + 1)
+                for a in node.args
+            )
+        return False
+    if isinstance(node, ast.Name):
+        bind = scope.latest_bind(node.id, use_line)
+        if bind is not None:
+            return _bounded(scope, bind, getattr(bind, "lineno", use_line),
+                            need, depth + 1)
+    return False
+
+
+def _clamp_guarded(scope: _Scope, node: ast.AST, use_line: int) -> bool:
+    """arccos/arcsin domination: the input must provably sit in [-1, 1]
+    on BOTH sides — a full clip/min-max sandwich with in-range literal
+    bounds, or a range-bounded producer (cos/sin/tanh)."""
+    return (
+        _bounded(scope, node, use_line, "lo")
+        and _bounded(scope, node, use_line, "hi")
+    )
+
+
+# --------------------------------------------------------------------------
+# differentiated-scope roots
+
+def _registry_grad_roots(root: pathlib.Path, modules) -> set:
+    """Roots from lint/registry.py: every in-scope function referenced by
+    the builder of a ``grad=True`` Entry.  Parsed, not imported — layer 1
+    stays jax-free — and automatically in sync with the jaxpr audit's
+    grad-registered set."""
+    reg = root / "esac_tpu" / "lint" / "registry.py"
+    if not reg.exists():
+        return set()
+    try:
+        tree = ast.parse(reg.read_text())
+    except (SyntaxError, UnicodeDecodeError, OSError):
+        return set()
+    aliases = _alias_map(tree)
+    builders: set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and (_dotted(node.func, aliases) or "").endswith("Entry")):
+            continue
+        kw = {k.arg: k.value for k in node.keywords}
+        g = kw.get("grad")
+        if not (isinstance(g, ast.Constant) and g.value is True):
+            continue
+        b = kw.get("build")
+        if isinstance(b, ast.Name):
+            builders.add(b.id)
+        elif isinstance(b, ast.Call) and isinstance(b.func, ast.Name):
+            builders.add(b.func.id)
+    funcs = {
+        n.name: n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    roots = set()
+    for name in builders:
+        fn = funcs.get(name)
+        if fn is None:
+            continue
+        for sub in ast.walk(fn):
+            if isinstance(sub, (ast.Name, ast.Attribute)):
+                d = _dotted(sub, aliases)
+                if d is None:
+                    continue
+                resolved = _resolve_function(d, modules)
+                if resolved:
+                    roots.add(resolved)
+    return roots
+
+
+def _scope_grad_roots(modules) -> set:
+    """Roots declared inside the scope packages themselves: functions fed
+    to jax.grad/value_and_grad/vjp/custom_vjp (decorator or call-site) and
+    the forward/backward pair of every ``defvjp`` registration."""
+    roots = set()
+    for mod in modules.values():
+        for name, fn in mod.functions.items():
+            for dec in fn.decorator_list:
+                for sub in ast.walk(dec):
+                    if _dotted(sub, mod.aliases) in _GRAD_WRAPPERS:
+                        roots.add((mod.dotted, name))
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func, mod.aliases)
+            is_defvjp = (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("defvjp", "defjvp")
+            )
+            if dotted not in _GRAD_WRAPPERS and not is_defvjp:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                names = []
+                if isinstance(arg, (ast.Name, ast.Attribute)):
+                    names.append(arg)
+                elif isinstance(arg, ast.Lambda):
+                    names.extend(
+                        n for n in ast.walk(arg.body)
+                        if isinstance(n, (ast.Name, ast.Attribute))
+                    )
+                for n in names:
+                    d = _dotted(n, mod.aliases)
+                    if d is None:
+                        continue
+                    if "." not in d and d in mod.functions:
+                        roots.add((mod.dotted, d))
+                    else:
+                        resolved = _resolve_function(d, modules)
+                        if resolved:
+                            roots.add(resolved)
+    return roots
+
+
+def _reachable_functions(root: pathlib.Path, modules) -> set:
+    roots = _registry_grad_roots(root, modules) | _scope_grad_roots(modules)
+    reachable: set = set()
+    frontier = list(roots)
+    while frontier:
+        key = frontier.pop()
+        if key in reachable:
+            continue
+        reachable.add(key)
+        mod = modules.get(key[0])
+        if mod is None:
+            continue
+        fn = mod.functions.get(key[1])
+        if fn is None:
+            continue
+        frontier.extend(_callees(mod, fn, modules))
+    return reachable
+
+
+# --------------------------------------------------------------------------
+# hazard scan
+
+_LOG_CALLS = {"log", "log2", "log10"}
+_ACOS_CALLS = {"arccos", "arcsin", "acos", "asin"}
+_DIV_CALLS = {"divide", "true_divide", "reciprocal"}
+
+
+def _where_branch_nodes(fn: ast.AST, aliases) -> set[int]:
+    """ids of every AST node inside a branch argument of a
+    jnp.where/lax.select call — the R15 (VJP-trap) position."""
+    out: set[int] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = (_dotted(node.func, aliases) or "").rpartition(".")[2]
+        if tail not in _SELECT_CALLS:
+            continue
+        for branch in node.args[1:3]:
+            for sub in ast.walk(branch):
+                out.add(id(sub))
+    return out
+
+
+def _fractional_pow_hazard(node: ast.BinOp) -> bool:
+    """x ** p is a domain-edge hazard iff p is fractional or negative (its
+    VJP has x**(p-1)); integer powers >= 1 are total.  A non-constant
+    exponent is not flagged (under-approximation documented in LINT.md:
+    every power in this codebase is a literal)."""
+    exp = node.right
+    if isinstance(exp, ast.UnaryOp) and isinstance(exp.op, ast.USub):
+        inner = exp.operand
+        return isinstance(inner, ast.Constant) and \
+            isinstance(inner.value, (int, float))
+    if not (isinstance(exp, ast.Constant)
+            and isinstance(exp.value, (int, float))):
+        return False
+    v = exp.value
+    return v < 1 or float(v) != float(int(v))
+
+
+def _scan_function(mod: _Module, fname: str, reported: set) -> list[Finding]:
+    """All R14/R15 hazards in one reachable function (full subtree: nested
+    defs and lambdas included — closures inherit differentiated scope)."""
+    fn = mod.functions[fname]
+    scope = _Scope(mod, fn)
+    in_where = _where_branch_nodes(fn, mod.aliases)
+    findings = []
+
+    def add(node, kind: str, message: str) -> None:
+        rule = "R15" if id(node) in in_where else "R14"
+        key = (rule, mod.rel, node.lineno, getattr(node, "col_offset", 0),
+               kind)
+        if key in reported:
+            return
+        reported.add(key)
+        if rule == "R15":
+            message += (
+                " — and it sits inside a jnp.where/select branch: the "
+                "untaken branch's VJP still runs (0 * inf = NaN poisons "
+                "the whole batch gradient); guard the operand instead "
+                "(utils/num.py, CLAUDE.md conventions)"
+            )
+        findings.append(Finding(
+            rule, mod.rel, node.lineno, _line_text(mod.lines, node.lineno),
+            message,
+        ))
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Div):
+            if not _guarded(scope, node.right, node.lineno):
+                add(node, "div",
+                    "division with an eps-free denominator in "
+                    "differentiated scope: the VJP multiplies by 1/y^2 and "
+                    "NaNs the batch gradient at y = 0 — add an eps, floor "
+                    "with jnp.maximum(y, k), or select-clamp the operand")
+        elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Pow):
+            if _fractional_pow_hazard(node) and \
+                    not _guarded(scope, node.left, node.lineno):
+                add(node, "pow",
+                    "fractional/negative power of a maybe-zero base in "
+                    "differentiated scope: d/dx x**p has x**(p-1), "
+                    "infinite at 0 — add an eps to the base (or use "
+                    "utils.num.safe_sqrt for p = 1/2)")
+        elif isinstance(node, ast.Call):
+            tail = (_dotted(node.func, mod.aliases) or "").rpartition(".")[2]
+            if tail in _DIV_CALLS and node.args:
+                den = node.args[1] if tail != "reciprocal" and \
+                    len(node.args) > 1 else node.args[0]
+                if not _guarded(scope, den, node.lineno):
+                    add(node, "div",
+                        f"jnp.{tail} with an eps-free denominator in "
+                        "differentiated scope — add an eps or floor the "
+                        "denominator")
+            elif tail in _ACOS_CALLS and node.args:
+                if not _clamp_guarded(scope, node.args[0], node.lineno):
+                    add(node, "acos",
+                        f"jnp.{tail} without a clamp dominating its input: "
+                        "the derivative is infinite at +-1, exactly where "
+                        "a perfectly-converged rotation lands — clip the "
+                        "input (or use the atan2 formulation as in "
+                        "geometry/rotations.py)")
+            elif tail in _LOG_CALLS and node.args:
+                if not _guarded(scope, node.args[0], node.lineno):
+                    add(node, "log",
+                        f"jnp.{tail} of a maybe-zero value in "
+                        "differentiated scope: log and its VJP are "
+                        "infinite at 0 — add an eps (x + 1e-12) or use "
+                        "log1p for near-zero arguments")
+    return findings
+
+
+def run_gradsafety_rules(root, files=None) -> list[Finding]:
+    """All R14/R15 findings (inline suppressions applied).  Tree-global
+    over the grad scope, like R11: a scoped run that touched any
+    geometry/ransac/train/lint file re-analyzes the whole scope (the call
+    graph is cross-file); other scoped runs skip the pass entirely."""
+    if not grad_pass_needed(files):
+        return []
+    root = pathlib.Path(root)
+    modules: dict[str, _Module] = {}
+    sources: dict[str, str] = {}
+    for rel in iter_python_files(root, files=None):
+        if not rel.startswith(GRAD_SCOPE_PREFIXES):
+            continue
+        try:
+            source = (root / rel).read_text()
+            tree = ast.parse(source, filename=rel)
+        except (SyntaxError, UnicodeDecodeError, OSError):
+            continue  # R0 is reported by the per-file pass
+        m = _Module(rel, tree, source.splitlines())
+        modules[m.dotted] = m
+        sources[rel] = source
+    if not modules:
+        return []
+
+    reachable = _reachable_functions(root, modules)
+    findings: list[Finding] = []
+    reported: set = set()
+    for mod_dotted, fname in sorted(reachable):
+        mod = modules.get(mod_dotted)
+        if mod is None or fname not in mod.functions:
+            continue
+        findings += _scan_function(mod, fname, reported)
+
+    out = []
+    cache: dict[str, tuple[dict, set]] = {}
+    for f in findings:
+        if f.path not in cache:
+            cache[f.path] = parse_suppressions(sources[f.path])
+        per_line, per_file = cache[f.path]
+        if not is_suppressed(f.rule, f.line, per_line, per_file, path=f.path):
+            out.append(f)
+    return out

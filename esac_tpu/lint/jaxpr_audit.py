@@ -138,11 +138,17 @@ def audit_jaxpr(name: str, closed_jaxpr, pinned: bool) -> list[Finding]:
 def trace_entries(entries=None) -> list:
     """Trace every registry entry ONCE: ``[(Entry, ClosedJaxpr | None)]``.
 
-    The shared tracing pass behind both the J1-J3 audit and the resource
-    ledger (:mod:`esac_tpu.lint.ledger`): tracing dominates layer-2 cost
-    (~20s full registry), so callers needing both must not trace twice.
-    ``None`` marks an entry not traceable in this process (e.g. no 8-device
-    mesh) — consumers skip it rather than failing.
+    The shared tracing pass behind the J1-J3 audit, the resource ledger
+    (:mod:`esac_tpu.lint.ledger`) AND the graft-audit v4 grad-hazard
+    census: tracing dominates layer-2 cost (~20s full registry), so
+    callers needing several must not trace twice.  The census's VJP leg
+    rides this same pass by construction — every ``Entry.grad=True``
+    builder traces a ``jax.make_jaxpr(jax.grad(...))`` program, so its
+    ClosedJaxpr IS forward + backward in one jaxpr (there is no separate
+    backward trace to take), and ``ledger.grad_hazard_census`` walks the
+    domain-edge primitives the autodiff transform emitted into it.
+    ``None`` marks an entry not traceable in this process (e.g. no
+    8-device mesh) — consumers skip it rather than failing.
     """
     _force_cpu()
     from esac_tpu.lint.registry import ENTRIES

@@ -31,6 +31,21 @@ findings (exit 1) while mere drift is reported stale (regenerate with
 ``python -m esac_tpu.lint --write-ledger`` and review the diff, exactly
 like the findings baseline).
 
+graft-audit v4 adds the **backward-jaxpr grad-hazard census** (rule
+**J5**): for every grad-registered entry (``Entry.grad=True`` — its build
+traces a ``jax.grad`` program, so the traced jaxpr IS forward + VJP), the
+walk additionally counts the domain-edge primitives the grad-safety
+convention polices — ``div``, ``rsqrt``, ``pow``, ``log``, ``acos``,
+``asin``, ``atan2`` — keyed by whether an eps-add / constant floor /
+clamp / select dominates the vulnerable operand (the producer chain is
+followed through broadcasts, reshapes, sqrt, mul and across
+pjit/scan/cond/custom-vjp boundaries).  The counts are committed per
+entry under ``grad_hazards``; :func:`diff_ledger` turns a NEW unguarded
+site into a J5 finding (exit 1) while improvements and guarded-count
+drift report stale — the J4 workflow verbatim.  This is the jaxpr-level
+sibling of the R14/R15 AST pass: the AST sees what the source says, the
+census sees every division the *autodiff transform itself* emits.
+
 Everything imports jax lazily; the tracing pass forces the CPU backend
 first (CLAUDE.md environment hazards).
 """
@@ -291,6 +306,411 @@ def _errmap_record(name: str, stats: dict) -> dict | None:
 
 
 # --------------------------------------------------------------------------
+# graft-audit v4: the backward-jaxpr grad-hazard census (J5)
+
+# Domain-edge primitive -> index of the vulnerable operand.  None = any
+# operand being dominated suffices (atan2 is singular only at the ORIGIN,
+# so one bounded-away operand guards it — the so3_log idiom).  acos/asin
+# are singular at +-1, NOT at 0: their guardedness goes through
+# range_dominated (a clamp/min-max sandwich with in-range bounds or a
+# [-1,1]-ranged producer), never the eps-add/floor rules.
+_HAZARD_PRIMS: dict[str, int | None] = {
+    "div": 1, "rsqrt": 0, "pow": 0, "log": 0, "acos": 0, "asin": 0,
+    "atan2": None,
+}
+_RANGE_EDGE_PRIMS = {"acos", "asin"}
+
+# Producer chains are followed transparently through these (they preserve
+# "bounded away from the edge" for the operands we track).
+_TRANSPARENT_PRIMS = {
+    "broadcast_in_dim", "convert_element_type", "reshape", "transpose",
+    "expand_dims", "squeeze", "slice", "rev", "copy", "neg", "abs",
+    "reduce_max", "reduce_min", "stop_gradient",
+}
+_CENSUS_DEPTH = 40
+
+
+def _is_literal(v) -> bool:
+    return hasattr(v, "val") and not hasattr(v, "count")
+
+
+def _nonzero_literal(v) -> bool:
+    if not _is_literal(v):
+        return False
+    try:
+        import numpy as np
+
+        return bool(np.all(np.asarray(v.val) != 0))
+    except Exception:
+        return False
+
+
+class _CensusIndex:
+    """Flattened var->producer map over a recursive jaxpr, with sub-jaxpr
+    invars aliased back onto the outer equation's operands so eps-adds
+    computed outside a scan/pjit body still dominate hazards inside it."""
+
+    def __init__(self, closed):
+        self.producer: dict[int, object] = {}   # id(var) -> eqn
+        self.alias: dict[int, object] = {}      # id(var) -> outer var/lit
+        self.consts: set[int] = set()           # id(var) of constvars
+        jaxpr = _as_jaxpr(closed)
+        self._visit(jaxpr, bindings=None)
+
+    def _visit(self, jaxpr, bindings) -> None:
+        for cv in getattr(jaxpr, "constvars", ()):
+            self.consts.add(id(cv))
+        if bindings:
+            for inner, outer in bindings:
+                self.alias[id(inner)] = outer
+        for eqn in jaxpr.eqns:
+            for v in eqn.outvars:
+                self.producer[id(v)] = eqn
+            name = eqn.primitive.name
+            params = eqn.params
+            if name == "scan":
+                sub = _as_jaxpr(params.get("jaxpr"))
+                if sub is not None:
+                    # scan invars = consts + init + xs; body invars line up
+                    # positionally (the xs slice aliases the stacked arg —
+                    # a per-step slice of a dominated stack is dominated).
+                    self._visit(sub, list(zip(sub.invars, eqn.invars)))
+            elif name == "cond":
+                for b in params.get("branches", ()):
+                    sub = _as_jaxpr(b)
+                    if sub is not None:
+                        self._visit(sub, list(zip(sub.invars, eqn.invars[1:])))
+            else:
+                for v in params.values():
+                    vals = v if isinstance(v, (list, tuple)) else (v,)
+                    for item in vals:
+                        sub = _as_jaxpr(item)
+                        if sub is None:
+                            continue
+                        binds = list(zip(sub.invars, eqn.invars)) \
+                            if len(sub.invars) == len(eqn.invars) else None
+                        self._visit(sub, binds)
+
+    def _resolve(self, v, depth: int):
+        seen = set()
+        while id(v) in self.alias and id(v) not in seen and depth > 0:
+            seen.add(id(v))
+            v = self.alias[id(v)]
+            depth -= 1
+        return v
+
+    @staticmethod
+    def _through_sub(eqn, v) -> list | None:
+        """Map an outer var produced by a sub-jaxpr-bearing eqn (pjit,
+        scan, cond, custom_vjp/remat...) onto the positionally matching
+        sub-jaxpr outvar(s): jnp.where itself lowers to a pjit around
+        select_n, so guard chains MUST cross these boundaries.  None =
+        no mapping (unknown layout)."""
+        try:
+            pos = next(
+                i for i, ov in enumerate(eqn.outvars) if ov is v
+            )
+        except StopIteration:
+            return None
+        name = eqn.primitive.name
+        params = eqn.params
+        if name == "cond":
+            subs = [_as_jaxpr(b) for b in params.get("branches", ())]
+            out = []
+            for sub in subs:
+                if sub is None or len(sub.outvars) != len(eqn.outvars):
+                    return None
+                out.append(sub.outvars[pos])
+            return out or None
+        subs = []
+        for val in params.values():
+            vals = val if isinstance(val, (list, tuple)) else (val,)
+            for item in vals:
+                sub = _as_jaxpr(item)
+                if sub is not None:
+                    subs.append(sub)
+        if len(subs) == 1 and len(subs[0].outvars) == len(eqn.outvars):
+            return [subs[0].outvars[pos]]
+        return None
+
+    def const_chain(self, v, depth: int = _CENSUS_DEPTH) -> bool:
+        """Value is a compile-time constant (literal / constvar, possibly
+        broadcast/cast/reshaped)."""
+        if depth <= 0:
+            return False
+        v = self._resolve(v, depth)
+        if _is_literal(v):
+            return True
+        if id(v) in self.consts:
+            return True
+        eqn = self.producer.get(id(v))
+        if eqn is None:
+            return False
+        if eqn.primitive.name in _TRANSPARENT_PRIMS or \
+                eqn.primitive.name == "mul":
+            return all(self.const_chain(iv, depth - 1) for iv in eqn.invars)
+        inner = self._through_sub(eqn, v)
+        if inner is not None:
+            return all(
+                (_is_literal(iv) and _nonzero_literal(iv))
+                or (not _is_literal(iv) and self.const_chain(iv, depth - 1))
+                for iv in inner
+            )
+        return False
+
+    def nonneg(self, v, depth: int = _CENSUS_DEPTH) -> bool:
+        """Provably nonnegative: squares (mul of a var with itself,
+        integer_pow with an even exponent), abs, exp, and sums/chains
+        thereof.  Used by the floored-plus-nonnegative add rule."""
+        if depth <= 0:
+            return False
+        v = self._resolve(v, depth)
+        if _is_literal(v):
+            try:
+                import numpy as np
+
+                return bool(np.all(np.asarray(v.val) >= 0))
+            except Exception:
+                return False
+        eqn = self.producer.get(id(v))
+        if eqn is None:
+            return False
+        name = eqn.primitive.name
+        if name in ("abs", "exp", "square"):
+            return True
+        if name == "mul":
+            if len(eqn.invars) == 2 and eqn.invars[0] is eqn.invars[1]:
+                return True
+            return all(self.nonneg(iv, depth - 1) for iv in eqn.invars)
+        if name == "integer_pow":
+            y = eqn.params.get("y")
+            if isinstance(y, int) and y % 2 == 0:
+                return True
+            return self.nonneg(eqn.invars[0], depth - 1)
+        if name in ("add", "reduce_sum", "max", "sqrt"):
+            return all(self.nonneg(iv, depth - 1) for iv in eqn.invars)
+        if name in _TRANSPARENT_PRIMS and name not in ("neg",):
+            return self.nonneg(eqn.invars[0], depth - 1)
+        inner = self._through_sub(eqn, v)
+        if inner is not None:
+            return all(self.nonneg(iv, depth - 1) for iv in inner)
+        return False
+
+    def _literal_in(self, v, lo: float, hi: float) -> bool:
+        if not _is_literal(v):
+            return False
+        try:
+            import numpy as np
+
+            arr = np.asarray(v.val)
+            return bool(np.all(arr >= lo) and np.all(arr <= hi))
+        except Exception:
+            return False
+
+    def _range_bounded(self, v, need: str, depth: int) -> bool:
+        """Provably >= -1 (``need='lo'``) or <= 1 (``need='hi'``) — the
+        acos/asin domain, whose edge is +-1, not 0."""
+        if depth <= 0:
+            return False
+        v = self._resolve(v, depth)
+        if _is_literal(v):
+            return self._literal_in(
+                v, -1.0, float("inf")
+            ) if need == "lo" else self._literal_in(v, float("-inf"), 1.0)
+        eqn = self.producer.get(id(v))
+        if eqn is None:
+            return False
+        name = eqn.primitive.name
+        if name in ("cos", "sin", "tanh"):
+            return True
+        if name == "clamp":
+            # lax.clamp(min, x, max): the relevant bound must be a literal
+            # actually inside [-1, 1] — clamp(-2, x, 2) guards nothing.
+            bound = eqn.invars[0] if need == "lo" else eqn.invars[2]
+            return self._literal_in(bound, -1.0, 1.0) or \
+                self._range_bounded(bound, need, depth - 1)
+        if name == "max":
+            check = any if need == "lo" else all
+            return check(
+                self._range_bounded(iv, need, depth - 1)
+                for iv in eqn.invars
+            )
+        if name == "min":
+            check = all if need == "lo" else any
+            return check(
+                self._range_bounded(iv, need, depth - 1)
+                for iv in eqn.invars
+            )
+        if name in ("convert_element_type", "broadcast_in_dim", "reshape",
+                    "transpose", "expand_dims", "squeeze", "slice", "rev",
+                    "copy", "stop_gradient"):
+            return self._range_bounded(eqn.invars[0], need, depth - 1)
+        inner = self._through_sub(eqn, v)
+        if inner is not None:
+            return all(
+                self._range_bounded(iv, need, depth - 1) for iv in inner
+            )
+        return False
+
+    def range_dominated(self, v, depth: int = _CENSUS_DEPTH) -> bool:
+        """acos/asin guardedness: the operand provably sits in [-1, 1]."""
+        return self._range_bounded(v, "lo", depth) and \
+            self._range_bounded(v, "hi", depth)
+
+    def _reaches_extremum(self, v, depth: int) -> bool:
+        if depth <= 0:
+            return False
+        v = self._resolve(v, depth)
+        eqn = self.producer.get(id(v))
+        if eqn is None:
+            return False
+        name = eqn.primitive.name
+        if name in ("reduce_max", "reduce_min"):
+            return True
+        if name in _TRANSPARENT_PRIMS:
+            return self._reaches_extremum(eqn.invars[0], depth - 1)
+        inner = self._through_sub(eqn, v)
+        if inner is not None:
+            return all(self._reaches_extremum(iv, depth - 1) for iv in inner)
+        return False
+
+    def _tie_count(self, v, depth: int) -> bool:
+        """The jnp.max/min VJP's denominator: convert_element_type(eq(x,
+        broadcast(reduce_max(x)))) summed over the reduced axis — at least
+        one element attains the extremum, so the count is >= 1."""
+        if depth <= 0:
+            return False
+        v = self._resolve(v, depth)
+        eqn = self.producer.get(id(v))
+        if eqn is None:
+            return False
+        name = eqn.primitive.name
+        if name == "convert_element_type":
+            return self._tie_count(eqn.invars[0], depth - 1)
+        if name == "eq":
+            return any(
+                not _is_literal(iv) and self._reaches_extremum(iv, depth - 1)
+                for iv in eqn.invars
+            )
+        return False
+
+    def dominated(self, v, depth: int = _CENSUS_DEPTH) -> bool:
+        """Is this value's producer chain dominated by an eps-add, constant
+        floor/clamp, or select?  False on reaching an entry input or an
+        unrecognized producer — unguarded over-approximates, like R14."""
+        if depth <= 0:
+            return False
+        v = self._resolve(v, depth)
+        if _is_literal(v):
+            return _nonzero_literal(v)
+        eqn = self.producer.get(id(v))
+        if eqn is None:
+            return False  # entry input or const capture: maybe-degenerate
+        name = eqn.primitive.name
+        if name == "add":
+            # x + eps (either operand a broadcast of a nonzero constant),
+            # or floored + nonnegative: x^2 + y^2 with x dominated stays
+            # >= x^2 > 0 — the atan2-VJP denominator every rotation-angle
+            # path in this codebase rests on.
+            if any(
+                _nonzero_literal(iv)
+                or (not _is_literal(iv) and self.const_chain(iv, depth - 1))
+                for iv in eqn.invars
+            ):
+                return True
+            a, b = eqn.invars[0], eqn.invars[1]
+            return (
+                (self.dominated(a, depth - 1) and self.nonneg(b, depth - 1))
+                or (self.dominated(b, depth - 1) and self.nonneg(a, depth - 1))
+            )
+        if name in ("max", "min"):
+            return any(
+                _is_literal(iv) or self.const_chain(iv, depth - 1)
+                or self.dominated(iv, depth - 1)
+                for iv in eqn.invars
+            )
+        if name in ("clamp", "select_n"):
+            return True  # the select-clamp idiom: the edge was handled
+        if name == "exp":
+            return True
+        if name in ("sqrt", "rsqrt", "integer_pow", "square"):
+            return self.dominated(eqn.invars[0], depth - 1)
+        if name == "reduce_sum":
+            # sum of a dominated, nonnegative field stays above the floor
+            # (the softmax denominator: reduce_sum of exp); and the
+            # max/min-VJP tie count — reduce_sum of an equality indicator
+            # against the reduced extremum — is >= 1 by construction (the
+            # extremum is attained), the one division autodiff itself
+            # emits for every jnp.max/argmax-free reduction.
+            if self.dominated(eqn.invars[0], depth - 1) and \
+                    self.nonneg(eqn.invars[0], depth - 1):
+                return True
+            return self._tie_count(eqn.invars[0], depth - 1)
+        if name == "mul":
+            return all(
+                _nonzero_literal(iv) or self.const_chain(iv, depth - 1)
+                or self.dominated(iv, depth - 1)
+                for iv in eqn.invars
+            )
+        if name == "div":
+            return self.dominated(eqn.invars[0], depth - 1)
+        if name in _TRANSPARENT_PRIMS:
+            return self.dominated(eqn.invars[0], depth - 1)
+        inner = self._through_sub(eqn, v)
+        if inner is not None:
+            return all(
+                (_nonzero_literal(iv) if _is_literal(iv)
+                 else self.dominated(iv, depth - 1))
+                for iv in inner
+            )
+        return False
+
+
+def grad_hazard_census(closed) -> dict:
+    """Per-primitive guarded/unguarded counts over one grad entry's traced
+    jaxpr (forward + VJP).  Counts are static equation counts — one per
+    compiled eqn, not per scan trip — so the committed record is exactly
+    reproducible (the tier-1 exact-match gate)."""
+    index = _CensusIndex(closed)
+    census: dict[str, dict[str, int]] = {}
+
+    def visit(jaxpr):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name in _HAZARD_PRIMS:
+                pos = _HAZARD_PRIMS[name]
+                if name in _RANGE_EDGE_PRIMS:
+                    # acos/asin: the edge is +-1, so an eps-add/floor
+                    # proves nothing — require a real range bound.
+                    guarded = index.range_dominated(eqn.invars[pos])
+                elif pos is None:
+                    guarded = any(index.dominated(iv) for iv in eqn.invars)
+                else:
+                    guarded = index.dominated(eqn.invars[pos])
+                slot = census.setdefault(name, {"guarded": 0, "unguarded": 0})
+                slot["guarded" if guarded else "unguarded"] += 1
+            if name == "scan":
+                sub = _as_jaxpr(eqn.params.get("jaxpr"))
+                if sub is not None:
+                    visit(sub)
+            elif name == "cond":
+                for b in eqn.params.get("branches", ()):
+                    sub = _as_jaxpr(b)
+                    if sub is not None:
+                        visit(sub)
+            else:
+                for v in eqn.params.values():
+                    vals = v if isinstance(v, (list, tuple)) else (v,)
+                    for item in vals:
+                        sub = _as_jaxpr(item)
+                        if sub is not None:
+                            visit(sub)
+
+    visit(_as_jaxpr(closed))
+    return {k: census[k] for k in sorted(census)}
+
+
+# --------------------------------------------------------------------------
 # ledger build / io / diff
 
 def build_ledger(traced) -> tuple[dict, set]:
@@ -307,19 +727,28 @@ def build_ledger(traced) -> tuple[dict, set]:
         stats = {"pinned": entry.pinned, **stats}
         if errmap is not None:
             stats["errmap"] = errmap
+        if getattr(entry, "grad", False):
+            # Grad-registered entry: the traced jaxpr carries the VJP, so
+            # the hazard census below IS the backward-pass record (J5).
+            stats["grad"] = True
+            stats["grad_hazards"] = grad_hazard_census(closed)
         entries[entry.name] = stats
     return entries, skipped
 
 
 def write_ledger(path: pathlib.Path, entries: dict) -> None:
     data = {
-        "comment": "graft-audit v2 jaxpr resource ledger; see LINT.md. "
+        "comment": "graft-audit v2/v4 jaxpr resource ledger; see LINT.md. "
                    "Per registered entry point at fixed tiny trace shapes: "
                    "analytic flops, peak intermediate bytes (liveness over "
-                   "the jaxpr — the pre-fusion materialization bound), and "
-                   "the dot_general precision census.  Regenerate with "
-                   "`python -m esac_tpu.lint --write-ledger` and review "
-                   "the diff; regressions beyond tolerance fail tier-1.",
+                   "the jaxpr — the pre-fusion materialization bound), the "
+                   "dot_general precision census, and — for grad-registered "
+                   "entries — the backward-jaxpr grad-hazard census "
+                   "(grad_hazards: domain-edge primitives keyed by whether "
+                   "an eps-add/floor/clamp dominates the vulnerable "
+                   "operand; a NEW unguarded site fails as J5).  Regenerate "
+                   "with `python -m esac_tpu.lint --write-ledger` and "
+                   "review the diff; regressions fail tier-1.",
         "entries": {k: entries[k] for k in sorted(entries)},
     }
     path.write_text(json.dumps(data, indent=2) + "\n")
@@ -345,7 +774,7 @@ def _census_counts(stats: dict) -> tuple[int, int]:
 def diff_ledger(
     committed: dict, current: dict, skipped: set = frozenset()
 ) -> tuple[list[Finding], list[str]]:
-    """-> (J4 regression findings, stale-entry notes).
+    """-> (J4/J5 regression findings, stale-entry notes).
 
     Regressions fail the lint: an entry missing from the committed ledger,
     peak bytes / flops growth beyond tolerance, or a precision-census
@@ -380,6 +809,42 @@ def diff_ledger(
                     "intentional, regenerate the ledger and review")
             elif now != was:
                 drift = True
+        # J5: the backward-jaxpr grad-hazard census (graft-audit v4).  A
+        # NEW unguarded domain-edge site in a grad entry fails; guarded
+        # drift, improvements, and a (de)registered census report stale.
+        old_h = old.get("grad_hazards")
+        cur_h = cur.get("grad_hazards")
+        if cur_h is not None:
+            if old_h is None:
+                findings.append(Finding(
+                    "J5", name, 0, "missing-hazard-census",
+                    "grad-registered entry has no committed grad_hazards "
+                    "census; run `python -m esac_tpu.lint --write-ledger`, "
+                    "review the unguarded counts, and commit the diff",
+                ))
+            else:
+                for prim, counts in cur_h.items():
+                    was = old_h.get(prim, {"guarded": 0, "unguarded": 0})
+                    if counts.get("unguarded", 0) > was.get("unguarded", 0):
+                        findings.append(Finding(
+                            "J5", name,
+                            0,
+                            f"{prim}:unguarded "
+                            f"{was.get('unguarded', 0)}->"
+                            f"{counts.get('unguarded', 0)}",
+                            f"new unguarded '{prim}' site in this entry's "
+                            "backward jaxpr: a domain-edge primitive whose "
+                            "vulnerable operand no eps-add/floor/clamp "
+                            "dominates — guard the operand (utils.num, "
+                            "select-clamp) or, if reviewed safe, "
+                            "regenerate the ledger and commit the diff",
+                        ))
+                    elif counts != was:
+                        drift = True
+                if any(p not in cur_h for p in old_h):
+                    drift = True
+        elif old_h is not None:
+            drift = True
         old_hi, old_other = _census_counts(old)
         new_hi, new_other = _census_counts(cur)
         if new_hi < old_hi and new_other > old_other:

@@ -30,6 +30,14 @@ class Entry:
     pinned: bool          # enforce HIGHEST/f32 on every dot_general
     build: Callable       # () -> jax.core.ClosedJaxpr | None (None = skip)
     note: str = ""
+    # grad=True marks a GRAD-REGISTERED entry: its build traces a
+    # jax.grad/value_and_grad program, so the traced jaxpr CONTAINS the
+    # backward pass (the VJP leg of the shared tracing pass).  These
+    # entries get the graft-audit v4 treatment: the J5 backward-jaxpr
+    # hazard census in the ledger (lint/ledger.py), the R14/R15 dataflow
+    # roots (lint/gradsafety.py parses this file for grad=True builders),
+    # and the degenerate-input gradient witness (lint/gradcheck.py).
+    grad: bool = False
 
 
 def _geom_inputs(n_cells: int = 16):
@@ -483,26 +491,26 @@ def _build_sharded_infer_frames_dynamic():
 
 
 ENTRIES: tuple[Entry, ...] = (
-    Entry("pnp_minimal_grad", pinned=True, build=_build_pnp_minimal_grad,
+    Entry("pnp_minimal_grad", pinned=True, grad=True, build=_build_pnp_minimal_grad,
           note="grad of solve_pnp_minimal wrt the 4 scene points"),
-    Entry("refine_soft_inliers_grad", pinned=True, build=_build_refine_grad,
+    Entry("refine_soft_inliers_grad", pinned=True, grad=True, build=_build_refine_grad,
           note="autodiff-through-IRLS backward (the reference's "
                "finite-difference replacement)"),
     Entry("dsac_infer", pinned=True, build=_build_dsac_infer,
           note="full single-frame hypothesis pipeline"),
-    Entry("dsac_train_loss_grad", pinned=True, build=_build_dsac_train_grad,
+    Entry("dsac_train_loss_grad", pinned=True, grad=True, build=_build_dsac_train_grad,
           note="training expectation + backward"),
-    Entry("scoring_errmap_grad", pinned=True, build=_build_scoring("errmap"),
+    Entry("scoring_errmap_grad", pinned=True, grad=True, build=_build_scoring("errmap"),
           note="reference-parity scoring impl"),
-    Entry("scoring_fused_grad", pinned=True, build=_build_scoring("fused"),
+    Entry("scoring_fused_grad", pinned=True, grad=True, build=_build_scoring("fused"),
           note="fused XLA broadcast+reduce scoring impl"),
-    Entry("scoring_fused_select_train_grad", pinned=True,
+    Entry("scoring_fused_select_train_grad", pinned=True, grad=True,
           build=_build_scoring("fused_select"),
           note="fused_select TRAINING scoring path: chunked+remat errmap "
                "math (soft_inlier_scores_chunked) — all scores for the "
                "softmax expectation, peak bytes bounded to one "
                "(score_chunk, n_cells) tile in forward and backward"),
-    Entry("scoring_fused_select_grad", pinned=True,
+    Entry("scoring_fused_select_grad", pinned=True, grad=True,
           build=_build_scoring_fused_select_grad,
           note="streamed score+select forward (chunked XLA sibling) + the "
                "custom_vjp backward that recomputes only the winner's "
@@ -512,7 +520,7 @@ ENTRIES: tuple[Entry, ...] = (
           note="full single-frame inference under scoring_impl="
                "'fused_select': selection fused into the scoring stream, "
                "no (n_hyps,) score vector in the program at all"),
-    Entry("esac_train_loss_dense_grad", pinned=True,
+    Entry("esac_train_loss_dense_grad", pinned=True, grad=True,
           build=_build_esac_train_grad,
           note="multi-expert dense training loss + backward"),
     Entry("dsac_infer_frames", pinned=True, build=_build_dsac_infer_frames,

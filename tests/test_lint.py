@@ -1235,6 +1235,45 @@ def test_committed_ledger_matches_tree_exactly(traced_registry):
         assert committed.get(name) == cur, f"ledger drift in {name}"
 
 
+def test_committed_hazard_census_matches_tree_exactly(traced_registry):
+    """graft-audit v4's exact-match gate, the J5 analog of the
+    ledger/lock-graph assertions: every grad-registered entry carries a
+    committed grad_hazards census, committed == recomputed exactly, and
+    the ONLY unguarded domain-edge site across every backward jaxpr is
+    the reviewed focal-length division in geometry/pnp.py bearings — the
+    same site the one R14 suppression covers, so the static, jaxpr and
+    suppression layers all tell one story."""
+    from esac_tpu.lint.ledger import (
+        LEDGER_NAME,
+        grad_hazard_census,
+        load_ledger,
+    )
+    from esac_tpu.lint.registry import ENTRIES
+
+    committed = load_ledger(REPO / LEDGER_NAME)
+    grad_entries = {e.name for e in ENTRIES if e.grad}
+    assert len(grad_entries) >= 8, "grad-registered entry set shrank"
+    traced, _ = traced_registry
+    by_name = {e.name: closed for e, closed in traced}
+    for name in sorted(grad_entries):
+        rec = committed[name]
+        assert rec.get("grad") is True, name
+        census = grad_hazard_census(by_name[name])
+        assert rec.get("grad_hazards") == census, f"census drift in {name}"
+        unguarded = {
+            prim: c["unguarded"] for prim, c in census.items()
+            if c["unguarded"]
+        }
+        # The reviewed residual: at most the single focal division per
+        # entry (entries whose trace reaches bearings), nothing else.
+        assert unguarded in ({}, {"div": 1}), (name, unguarded)
+    # Non-grad entries must NOT carry a census (forward-only traces have
+    # no backward to walk — a census there would be a lie).
+    for name, rec in committed.items():
+        if name not in grad_entries:
+            assert "grad_hazards" not in rec, name
+
+
 def test_committed_ledger_quantifies_the_scoring_errmap():
     """DESIGN.md §9's errmap claim as a committed number — ISSUE 8 flipped
     its sign on the inference side: every INFERENCE entry records the
