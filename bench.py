@@ -94,6 +94,19 @@ OBS_REPEATS = 9          # interleaved off/on passes; the ~20% CPU run
                          # jitter needs medians over many pairs for a
                          # sub-3% overhead verdict to mean anything
 
+PREFETCH_SCENES = 12     # fleet size of the tier sweep — 4x the device
+                         # budget, so the HBM byte budget CANNOT hold the
+                         # working set and the tier hierarchy is what
+                         # stands between the tail and the disk class
+PREFETCH_OVERSUB_X = 4   # HBM oversubscription: budget = n_scenes/this
+PREFETCH_REQUESTS = 240  # Zipf trace length per leg (same trace, 3 legs)
+PREFETCH_ZIPF_A = 1.1    # scene-popularity skew (city-fleet shape: a hot
+                         # head, a long tail that keeps faulting)
+PREFETCH_HW = 24         # tiny frames: the sweep measures WEIGHT
+                         # LOCALITY classes, not CNN throughput
+PREFETCH_M = 2
+PREFETCH_HYPS = 4
+
 CHAOS_M = 2              # experts in the chaos drill's synthetic scenes
 CHAOS_HW = 24            # tiny frames: the drill measures FAULT routing
                          # and recovery, not throughput (cf. loadtest)
@@ -114,6 +127,7 @@ _LOADTEST_FILE = _REPO / ".serve_loadtest.json"
 _SCORING_FILE = _REPO / ".scoring_fused.json"
 _CHAOS_FILE = _REPO / ".chaos_drill.json"
 _OBS_FILE = _REPO / ".obs_overhead.json"
+_PREFETCH_FILE = _REPO / ".weight_tiers.json"
 
 
 def _measure_jax(
@@ -309,8 +323,8 @@ def _measure_registry_at(root: pathlib.Path, n_scenes: int, repeats: int) -> dic
     from esac_tpu.models import ExpertNet, GatingNet
     from esac_tpu.ransac import RansacConfig
     from esac_tpu.registry import (
-        SceneEntry, SceneManifest, ScenePreset, SceneRegistry, tree_nbytes,
-        load_scene_params,
+        HostWeightTier, SceneEntry, SceneManifest, ScenePreset,
+        SceneRegistry, tree_nbytes, load_scene_params,
     )
     from esac_tpu.utils.checkpoint import save_checkpoint
 
@@ -405,6 +419,20 @@ def _measure_registry_at(root: pathlib.Path, n_scenes: int, repeats: int) -> dic
     evicted_reload = [timed(disp_t, frames[i], sids[i % len(sids)])
                       for i in range(repeats)]
 
+    # Host-tier hit (ISSUE 13, DESIGN.md §17): the class the compressed
+    # host-RAM tier inserts between warm and cold — each sample demotes
+    # the scene out of HBM and re-serves it, paying decompress + staging
+    # but NO disk IO and NO checksum re-read.  The cold/warm/host-hit
+    # triple is the committed latency table of the tier hierarchy.
+    tiered = SceneRegistry(manifest,
+                           host_tier=HostWeightTier(compression="bf16"))
+    disp_h = tiered.dispatcher(cfg, start_worker=False)
+    disp_h.infer_one(frames[0], scene=sids[0])  # load + this registry's compile
+    host_hit = []
+    for i in range(repeats):
+        tiered.cache.demote((sids[0], 1))
+        host_hit.append(timed(disp_h, frames[i], sids[0]))
+
     return {
         "n_scenes": n_scenes,
         "scene_nbytes": scene_nbytes,
@@ -419,16 +447,256 @@ def _measure_registry_at(root: pathlib.Path, n_scenes: int, repeats: int) -> dic
         "hot_swap_spread_ms": [round(x, 2) for x in sorted(hot_swap)],
         "evicted_reload_ms": round(med(evicted_reload), 2),
         "evicted_reload_spread_ms": [round(x, 2) for x in sorted(evicted_reload)],
+        "host_tier_hit_ms": round(med(host_hit), 2),
+        "host_tier_hit_spread_ms": [round(x, 2) for x in sorted(host_hit)],
+        "host_tier_compression": "bf16",
         "compiled_programs_after_all_swaps": compiles_after_swaps,
         "cache_stats_shared_registry": stats_shared,
         "cold_over_warm_x": round(med(cold_load) / max(med(warm_hit), 1e-9), 2),
         "swap_over_warm_x": round(med(hot_swap) / max(med(warm_hit), 1e-9), 2),
+        "host_over_warm_x": round(med(host_hit) / max(med(warm_hit), 1e-9), 2),
+        "cold_over_host_x": round(med(cold_load) / max(med(host_hit), 1e-9), 2),
         "note": (
             "one preset shared by all scenes: compiled_programs_after_all_"
             "swaps == len(frame_buckets) proves hot-swapping never "
             "recompiles; hot_swap vs warm_hit isolates the cost of "
             "changing the params argument; evicted_reload cycles a "
-            "budget one scene too small (worst-case thrash)"
+            "budget one scene too small (worst-case thrash); "
+            "host_tier_hit demotes out of HBM then re-serves through the "
+            "bf16 host tier (decompress + stage, no disk IO) — the class "
+            "a demoted scene pays instead of the cold class"
+        ),
+    }
+
+
+def _measure_prefetch(
+    n_scenes: int = PREFETCH_SCENES,
+    n_requests: int = PREFETCH_REQUESTS,
+) -> dict:
+    """Tiered weight hierarchy sweep (ISSUE 13, DESIGN.md §17): a Zipf
+    scene-popularity trace over a fleet whose HBM budget holds only
+    1/PREFETCH_OVERSUB_X of the scenes, served three ways:
+
+    - ``on_demand``         — device cache only (PR-3 semantics): every
+      re-admission of an evicted scene pays the DISK cold-load class;
+    - ``host_tier``         — + compressed bf16 host-RAM tier: eviction
+      demotes, re-admission promotes without disk IO;
+    - ``host_tier_prefetch``— + the predictive prefetcher driving tier
+      admissions from the dispatcher's arrival stream, ahead of faults.
+
+    Same trace, same scenes, fresh registry per leg.  Per leg: served
+    p50/p99, exact outcome accounting, per-tier fault classes (device
+    hit / host hit / disk load / demotion), prefetch decisions, and the
+    jit cache-miss counter (zero recompiles across every tier
+    transition).  The headline is the p99 cut of the full hierarchy vs
+    on-demand.
+    """
+    import shutil
+    import tempfile
+
+    root = pathlib.Path(tempfile.mkdtemp(prefix="esac_prefetch_bench_"))
+    try:
+        return _measure_prefetch_at(root, n_scenes, n_requests)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _measure_prefetch_at(root: pathlib.Path, n_scenes: int,
+                         n_requests: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from esac_tpu.models import ExpertNet, GatingNet
+    from esac_tpu.ransac import RansacConfig
+    from esac_tpu.registry import (
+        HostWeightTier, PrefetchPolicy, SceneEntry, SceneManifest,
+        ScenePreset, SceneRegistry, load_scene_params, tree_nbytes,
+    )
+    from esac_tpu.utils.checkpoint import save_checkpoint
+
+    H = W = PREFETCH_HW
+    M = PREFETCH_M
+    preset = ScenePreset(
+        height=H, width=W, num_experts=M,
+        stem_channels=(2, 4, 8), head_channels=8, head_depth=1,
+        gating_channels=(4,), compute_dtype="float32", gated=True,
+    )
+    # serve_max_wait_ms=0: one request per dispatch — the sweep measures
+    # per-request weight-locality classes, not coalescing.
+    cfg = RansacConfig(n_hyps=PREFETCH_HYPS, refine_iters=2, polish_iters=1,
+                       frame_buckets=(1,), serve_max_wait_ms=0.0,
+                       serve_queue_depth=512)
+
+    expert = ExpertNet(
+        scene_center=(0.0, 0.0, 0.0), stem_channels=preset.stem_channels,
+        head_channels=preset.head_channels, head_depth=preset.head_depth,
+        compute_dtype=jnp.float32,
+    )
+    gating = GatingNet(num_experts=M, channels=preset.gating_channels,
+                       compute_dtype=jnp.float32)
+    img0 = jnp.zeros((1, H, W, 3))
+
+    def write_scene(i):
+        e_params = jax.vmap(lambda k: expert.init(k, img0))(
+            jax.random.split(jax.random.key(i), M)
+        )
+        centers = (np.asarray([[0.0, 0.0, 2.0]], np.float32)
+                   + np.arange(M, dtype=np.float32)[:, None] * 0.1 + i * 0.01)
+        d = root / f"scene{i}"
+        save_checkpoint(d / "expert", e_params, {
+            "stem_channels": list(preset.stem_channels),
+            "head_channels": preset.head_channels,
+            "head_depth": preset.head_depth,
+            "scene_centers": centers.tolist(),
+            "f": 40.0, "c": [W / 2.0, H / 2.0],
+        })
+        save_checkpoint(d / "gating",
+                        gating.init(jax.random.key(1000 + i), img0),
+                        {"num_experts": M})
+        return SceneEntry(
+            scene_id=f"scene{i}", version=1,
+            expert_ckpt=str(d / "expert"), gating_ckpt=str(d / "gating"),
+            preset=preset, ransac=cfg,
+        )
+
+    manifest = SceneManifest()
+    entries = [manifest.add(write_scene(i)) for i in range(n_scenes)]
+    sids = [e.scene_id for e in entries]
+    # Prime the OS page cache over every checkpoint ONCE, before any leg:
+    # leg ordering must compare tier policy, not disk-cache temperature.
+    for e in entries:
+        load_scene_params(e)
+    scene_nbytes = tree_nbytes(jax.device_put(load_scene_params(entries[0])))
+    budget_scenes = max(1, n_scenes // PREFETCH_OVERSUB_X)
+    device_budget = scene_nbytes * budget_scenes + 1
+
+    # One Zipf trace shared by every leg: rank r served with p ~ 1/(r+1)^a.
+    rng = np.random.default_rng(13)
+    p = 1.0 / (np.arange(n_scenes) + 1.0) ** PREFETCH_ZIPF_A
+    p /= p.sum()
+    trace = rng.choice(n_scenes, size=n_requests, p=p)
+
+    def frame(i):
+        return {
+            "key": jax.random.fold_in(jax.random.key(7), i),
+            "image": np.asarray(jax.random.uniform(
+                jax.random.fold_in(jax.random.key(42), i), (H, W, 3)
+            )),
+        }
+
+    pool = [frame(i) for i in range(8)]
+
+    def med(xs):
+        return sorted(xs)[len(xs) // 2]
+
+    def pct(xs, q):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))]
+
+    def run_leg(tier, prefetch):
+        reg = SceneRegistry(manifest, budget_bytes=device_budget,
+                            host_tier=tier)
+        pf = None
+        if prefetch:
+            # device_scenes leaves ONE budget slot as demand-fault
+            # headroom: pinning the full budget makes every tail fault
+            # evict a prefetched hot scene (promote/evict ping-pong the
+            # cooldown then throttles but headroom avoids outright).
+            pf = reg.attach_prefetcher(PrefetchPolicy(
+                interval_ms=3.0, halflife_s=2.0,
+                device_scenes=max(1, budget_scenes - 1),
+                max_device_per_cycle=2, max_host_per_cycle=4,
+            ))
+        disp = reg.dispatcher(cfg)
+        try:
+            # Off the trace: the one compile the whole fleet shares, then
+            # one warm pass over every scene — identical in every leg, so
+            # the measured trace compares steady-state weight LOCALITY,
+            # not first-ever disk touches.  The on-demand leg's budget
+            # cannot HOLD the warmed fleet (that is the point): its
+            # evictions drop to disk, the tier legs' demote to host RAM.
+            for s in sids:
+                disp.infer_one(pool[0], scene=s, deadline_ms=300_000.0)
+            compiled = reg.compile_cache_size()
+            disp.reset_stats()
+            lat = []
+            for i, s in enumerate(trace):
+                t0 = time.perf_counter()
+                disp.infer_one(pool[i % len(pool)], scene=sids[int(s)],
+                               deadline_ms=300_000.0)
+                lat.append((time.perf_counter() - t0) * 1e3)
+            totals = disp.slo_totals()
+            snap = disp.obs.snapshot() if prefetch else None
+        finally:
+            if pf is not None:
+                pf.close()
+            disp.close()
+        cache = reg.cache.stats()
+        outcome_sum = (totals["served"] + totals["shed"] + totals["expired"]
+                       + totals["degraded"] + totals["failed"]
+                       + totals["pending"])
+        leg = {
+            "served_p50_ms": round(pct(lat, 0.50), 2),
+            "served_p99_ms": round(pct(lat, 0.99), 2),
+            "served_mean_ms": round(sum(lat) / len(lat), 2),
+            "wall_s": round(sum(lat) / 1e3, 3),
+            "outcomes": totals,
+            "sums_to_offered": outcome_sum == totals["offered"],
+            "fault_classes": {
+                "device_hits": cache["hits"],
+                "host_hits": cache["host_hits"],
+                "disk_loads": cache["disk_loads"],
+                "demotions": cache["demotions"],
+            },
+            "cache_stats": cache,
+            "tier_stats": tier.stats() if tier is not None else None,
+            "prefetch_stats": pf.stats() if pf is not None else None,
+            "compiled_programs": reg.compile_cache_size(),
+            "recompiles_during_trace": reg.compile_cache_size() - compiled,
+        }
+        return leg, snap
+
+    on_demand, _ = run_leg(tier=None, prefetch=False)
+    host_tier, _ = run_leg(tier=HostWeightTier(compression="bf16"),
+                           prefetch=False)
+    full, fleet_snap = run_leg(tier=HostWeightTier(compression="bf16"),
+                               prefetch=True)
+
+    def cut(a, b):
+        return round(a / max(b, 1e-9), 2)
+
+    return {
+        "scenes": {"n": n_scenes, "hw": [H, W], "num_experts": M,
+                   "n_hyps": PREFETCH_HYPS, "scene_nbytes": scene_nbytes},
+        "device_budget_bytes": device_budget,
+        "device_budget_scenes": budget_scenes,
+        "hbm_oversubscription_x": round(n_scenes / budget_scenes, 2),
+        "zipf_alpha": PREFETCH_ZIPF_A,
+        "requests_per_leg": n_requests,
+        "compression": "bf16",
+        "legs": {
+            "on_demand": on_demand,
+            "host_tier": host_tier,
+            "host_tier_prefetch": full,
+        },
+        "p99_cut_x_host_tier": cut(on_demand["served_p99_ms"],
+                                   host_tier["served_p99_ms"]),
+        "p99_cut_x_prefetch": cut(on_demand["served_p99_ms"],
+                                  full["served_p99_ms"]),
+        "p50_cut_x_prefetch": cut(on_demand["served_p50_ms"],
+                                  full["served_p50_ms"]),
+        "obs_snapshot": fleet_snap,
+        "note": (
+            "same Zipf trace over the same scenes, fresh registry per "
+            "leg, one compile per leg off the trace; HBM budget holds "
+            f"{budget_scenes}/{n_scenes} scenes so the on-demand leg "
+            "re-pays the disk cold-load class on every tail fault; the "
+            "host tier converts those to decompress+stage promotions; "
+            "the prefetcher converts hot-scene faults into pre-staged "
+            "warm hits ahead of arrival; outcome classes sum exactly to "
+            "offered and the jit cache-miss counter pins zero recompiles "
+            "across all tier transitions in every leg"
         ),
     }
 
@@ -1644,6 +1912,8 @@ def device_child(kwargs: dict) -> None:
         payload = {"chaos": _measure_chaos(**kwargs)}
     elif kwargs.pop("obs", False):
         payload = {"obs": _measure_obs(**kwargs)}
+    elif kwargs.pop("prefetch", False):
+        payload = {"prefetch": _measure_prefetch(**kwargs)}
     else:
         payload = {"rate": _measure_jax(**kwargs)}
     import jax
@@ -2194,6 +2464,34 @@ def _obs_headline(obs: dict) -> dict:
     }
 
 
+def _prefetch_headline(prefetch: dict) -> dict:
+    legs = prefetch["legs"]
+    return {
+        "metric": "weight_tier_served_p99_cut_x",
+        "value": prefetch["p99_cut_x_prefetch"],
+        "unit": "x",
+        "vs_baseline": None,
+        "p99_cut_x_host_tier": prefetch["p99_cut_x_host_tier"],
+        "hbm_oversubscription_x": prefetch["hbm_oversubscription_x"],
+        "on_demand_p99_ms": legs["on_demand"]["served_p99_ms"],
+        "prefetch_p99_ms": legs["host_tier_prefetch"]["served_p99_ms"],
+        "accounting_exact": all(
+            leg["sums_to_offered"] for leg in legs.values()
+        ),
+        "recompiles": sum(
+            leg["recompiles_during_trace"] for leg in legs.values()
+        ),
+    }
+
+
+def _prefetch_main(stopped: list[int], load_before: list[float]) -> None:
+    """``python bench.py prefetch`` — the DESIGN.md §17 tiered weight
+    hierarchy sweep through the shared scaffold (.weight_tiers.json)."""
+    _driver_main(stopped, load_before, key="prefetch", what="tier sweep",
+                 measure_cpu=lambda: _measure_prefetch(),
+                 artifact_path=_PREFETCH_FILE, headline=_prefetch_headline)
+
+
 def _obs_main(stopped: list[int], load_before: list[float]) -> None:
     """``python bench.py obs`` — the ISSUE 10 observability overhead gate
     (DESIGN.md §14) through the shared scaffold (.obs_overhead.json)."""
@@ -2211,6 +2509,7 @@ def _main_measured(stopped: list[int], load_before: list[float]) -> None:
         "scoring": _scoring_main,
         "chaos": _chaos_main,
         "obs": _obs_main,
+        "prefetch": _prefetch_main,
     }
     if len(sys.argv) > 1 and sys.argv[1] in modes:
         modes[sys.argv[1]](stopped, load_before)

@@ -143,22 +143,49 @@ class LockWitness:
         return self
 
     def attach_fleet(self, disp=None, registry=None, injector=None,
-                     ) -> "LockWitness":
+                     prefetcher=None) -> "LockWitness":
         """One-call wiring for the shipped fleet shapes: a
         MicroBatchDispatcher (lock + conditions + its obs instruments),
-        a SceneRegistry (health/program locks, manifest, weight cache,
-        its obs registry), and optionally a FaultInjector."""
+        a SceneRegistry (health/program locks, manifest, weight cache +
+        its host tier when attached, its obs registry), a
+        WeightPrefetcher, and optionally a FaultInjector.  The
+        attach-before-start contract is ENFORCED for the prefetcher: an
+        explicitly passed one whose thread is already running raises
+        (rebuilding its Condition would strand the live waiter); an
+        auto-discovered running one is skipped silently — the subgraph
+        check is one-sided, an unwitnessed lock only shrinks the
+        observed set."""
         if registry is not None:
             self.attach(registry, "_health_lock", "_fns_lock")
             self.attach(registry.manifest, "_lock")
             self.attach(registry.cache, "_lock")
+            if getattr(registry.cache, "tier", None) is not None:
+                self.attach(registry.cache.tier, "_lock")
+            auto_pf = getattr(registry, "_prefetcher", None)
+            if auto_pf is not None and prefetcher is None \
+                    and not self._thread_running(auto_pf):
+                prefetcher = auto_pf
             self.attach_obs(registry.obs)
+        if prefetcher is not None:
+            if self._thread_running(prefetcher):
+                raise ValueError(
+                    "attach the witness BEFORE the prefetcher starts "
+                    "(attach_prefetcher(start=False) -> attach_fleet -> "
+                    "start()): wrapping a live thread's lock rebuilds "
+                    "its Condition under the waiter and strands it"
+                )
+            self.attach(prefetcher, "_lock")
         if disp is not None:
             self.attach(disp, "_lock")
             self.attach_obs(disp.obs)
         if injector is not None:
             self.attach(injector, "_lock")
         return self
+
+    @staticmethod
+    def _thread_running(obj) -> bool:
+        t = getattr(obj, "_thread", None)
+        return t is not None and t.is_alive()
 
     # ---- recording (called from WitnessLock; no witnessed lock taken) ----
 

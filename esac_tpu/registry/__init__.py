@@ -9,9 +9,21 @@ fns whose weights are jit *arguments* bucketed by :class:`ScenePreset` —
 so swapping scenes never recompiles and never restages a cached scene.
 The scene-aware `serve.MicroBatchDispatcher` coalesces requests per
 (scene, frame-bucket) with round-robin fairness across scenes.
+
+Tiered weight hierarchy (DESIGN.md §17): a :class:`HostWeightTier`
+turns the device cache into the top of a device-HBM → compressed
+host-RAM → disk hierarchy (LRU eviction demotes, breaker trips purge
+both tiers), and a :class:`WeightPrefetcher` drives tier admissions
+from the dispatcher's per-scene arrival stream, ahead of the fault.
 """
 
 from esac_tpu.registry.cache import DeviceWeightCache, tree_nbytes
+from esac_tpu.registry.hosttier import (
+    HostWeightTier,
+    compress_tree,
+    decompress_tree,
+)
+from esac_tpu.registry.prefetch import PrefetchPolicy, WeightPrefetcher
 from esac_tpu.registry.health import (
     ChecksumMismatchError,
     HealthPolicy,
@@ -41,7 +53,12 @@ __all__ = [
     "ChecksumMismatchError",
     "DeviceWeightCache",
     "HealthPolicy",
+    "HostWeightTier",
     "ManifestError",
+    "PrefetchPolicy",
+    "WeightPrefetcher",
+    "compress_tree",
+    "decompress_tree",
     "SceneEntry",
     "SceneLoadError",
     "SceneManifest",

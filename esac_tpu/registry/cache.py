@@ -26,6 +26,24 @@ Invariants the serving layer relies on:
   version's tree ages out by LRU — in-flight dispatches that already hold
   the old tree keep a Python reference, so eviction can never free buffers
   under a running computation.
+
+Tiered hierarchy (ISSUE 13, DESIGN.md §17): with a
+``registry.hosttier.HostWeightTier`` attached, this cache is the TOP of a
+three-level hierarchy (device HBM -> compressed host RAM -> disk):
+
+- a miss first consults the host tier — a host hit promotes by
+  decompress + ``device_put`` only (no disk IO, no checksum re-read:
+  checksums were verified once on the disk -> host load);
+- a disk load admits the compressed payload into the host tier and
+  STAGES THE DECOMPRESSED PAYLOAD, not the raw read — so the device
+  bytes are identical whichever tier a scene arrived from (with
+  ``compression="none"`` that is bit-identical to the raw read; pinned);
+- LRU eviction DEMOTES instead of drops: the victim's retained payload
+  object is re-admitted to the host tier (no recompression, no device
+  sync — the payload is immutable host memory), so a re-admitted scene
+  pays the ~3ms class, not the ~29ms class;
+- :meth:`evict` stays the PURGE path (breaker trips route here): the key
+  leaves BOTH tiers — known-bad weights must not survive in any tier.
 """
 
 from __future__ import annotations
@@ -69,15 +87,26 @@ class DeviceWeightCache:
         loader: Callable[[Any], Any],
         budget_bytes: int | None = None,
         device=None,
+        tier=None,
     ):
         if budget_bytes is not None and budget_bytes <= 0:
             raise ValueError(f"budget_bytes {budget_bytes} must be positive")
         self._loader = loader
         self._budget = budget_bytes
         self._device = device
+        # The host-RAM tier below this cache (registry/hosttier.py), or
+        # None for the single-level PR-3 behavior, byte-for-byte.
+        # Immutable post-init; tier calls NEVER happen under this
+        # cache's lock (victims are collected locked, demoted outside —
+        # the committed lock graph has no cache -> tier edge).
+        self.tier = tier
         self._lock = threading.Lock()
         self._trees: "collections.OrderedDict[Any, Any]" = collections.OrderedDict()
         self._nbytes: dict[Any, int] = {}
+        # key -> the host-tier payload each resident tree was staged
+        # from: demotion re-admits this exact immutable object, so a
+        # demote -> promote cycle can never recompress or drift.
+        self._payloads: dict[Any, Any] = {}
         # key -> in-flight load future: {"event", "result", "error"}.
         self._loading: dict[Any, dict] = {}
         # Bumped by clear(): a load that straddles a clear still resolves
@@ -87,6 +116,9 @@ class DeviceWeightCache:
         self._gen = 0
         self.hits = 0
         self.misses = 0
+        self.host_hits = 0    # misses promoted from the host tier
+        self.disk_loads = 0   # misses that paid the full loader path
+        self.demotions = 0    # LRU evictions re-admitted to the tier
         self.load_failures = 0
         # Bounded like the dispatcher's stats deques: a thrashing server
         # evicts per request for days — the recent window is the record,
@@ -127,16 +159,31 @@ class DeviceWeightCache:
                 raise fut["error"]
             return fut["result"]
         try:
-            host = self._loader(entry)
+            host, payload, from_tier = self._read_host(entry)
             tree = (
                 jax.device_put(host, self._device)
                 if self._device is not None else jax.device_put(host)
             )
             with self._lock:
-                if gen == self._gen:
+                # Two reasons NOT to cache a completed load: clear()
+                # bumped the generation, or evict() PURGED this key while
+                # the load was in flight (breaker trip racing a demand
+                # fault / prefetch — caching would resurrect exactly the
+                # weights the trip just removed).  The caller still gets
+                # the tree either way: in-flight dispatches drain on the
+                # entry they resolved.
+                if gen == self._gen and not fut.get("discard"):
                     self._trees[key] = tree
                     self._nbytes[key] = tree_nbytes(tree)
-                    self._evict_to_budget()
+                    if payload is not None:
+                        self._payloads[key] = payload
+                    demoted = self._evict_to_budget()
+                else:
+                    demoted = []
+                if from_tier:
+                    self.host_hits += 1
+                else:
+                    self.disk_loads += 1
                 fut["result"] = tree
                 self._loading.pop(key, None)
         except BaseException as e:
@@ -152,19 +199,98 @@ class DeviceWeightCache:
                 self._loading.pop(key, None)
                 self._trees.pop(key, None)
                 self._nbytes.pop(key, None)
+                self._payloads.pop(key, None)
             fut["event"].set()
             raise
         fut["event"].set()
+        self._demote(demoted)
         return tree
 
-    def _evict_to_budget(self) -> None:
+    def _read_host(self, entry):
+        """The owner's host-side read (NO cache lock held): returns
+        ``(host tree, tier payload or None, from_tier)``.  With a tier,
+        the host tier is consulted first (a hit skips disk AND the
+        checksum re-read), a miss pays the loader through the tier's
+        per-key future (so a prefetch racing this demand fault coalesces
+        onto one disk read), and the staged tree is ALWAYS the
+        decompressed payload — the device bytes are identical whichever
+        tier the scene arrived from."""
+        from esac_tpu.registry import hosttier
+
+        if self.tier is None:
+            return self._loader(entry), None, False
+        hit = entry.key in self.tier
+        payload = self.tier.get_or_load(
+            entry.key, lambda: self.tier.compress(self._loader(entry))
+        )
+        return hosttier.decompress_tree(payload), payload, hit
+
+    def preload_host(self, entry) -> bool:
+        """Stage ``entry`` into the HOST tier only (disk -> compressed
+        RAM, no device staging) — the prefetcher's second-tier
+        admission.  Rides the tier's per-key future: concurrent callers
+        (and the demand fault this predicts) share one disk read.
+        True if a load was needed, False when already resident in
+        either tier (a device-resident key's payload is retained by
+        this cache, so re-reading disk for it would be pure waste)."""
+        if self.tier is None:
+            raise ValueError("preload_host needs a host tier attached")
+        key = entry.key
+        with self._lock:
+            resident = key in self._trees
+        if resident or key in self.tier:
+            return False
+        self.tier.get_or_load(
+            key, lambda: self.tier.compress(self._loader(entry))
+        )
+        return True
+
+    def _evict_to_budget(self) -> list:
+        """LRU-evict down to the byte budget (lock held); returns the
+        [(key, payload)] victims for the caller to demote into the host
+        tier OUTSIDE the lock (tier admission takes the tier's lock and
+        must never nest under this one)."""
+        demoted = []
         if self._budget is None:
-            return
+            return demoted
         while len(self._trees) > 1 and self._bytes_in_use() > self._budget:
             victim, _ = self._trees.popitem(last=False)
             del self._nbytes[victim]
+            payload = self._payloads.pop(victim, None)
+            if payload is not None:
+                self.demotions += 1
+                demoted.append((victim, payload))
             self.evictions.append(victim)
             self.evictions_total += 1
+        return demoted
+
+    def _demote(self, demoted: list) -> None:
+        """Re-admit evicted entries' payloads to the host tier (NO cache
+        lock held) — the evict-to-tier path: pure host-memory pointer
+        movement, no device sync, no recompression."""
+        if self.tier is None:
+            return
+        for key, payload in demoted:
+            self.tier.admit(key, payload)
+
+    def demote(self, key) -> bool:
+        """Explicitly push one entry down to the host tier (drop the
+        device tree, re-admit the retained payload): the operator /
+        bench hook for the eviction path's semantics without byte
+        pressure.  True if the key was device-resident."""
+        with self._lock:
+            if key not in self._trees:
+                return False
+            del self._trees[key]
+            del self._nbytes[key]
+            payload = self._payloads.pop(key, None)
+            if payload is not None:
+                self.demotions += 1
+            self.evictions.append(key)
+            self.evictions_total += 1
+        if payload is not None:
+            self._demote([(key, payload)])
+        return True
 
     # ---- introspection / management ----
 
@@ -192,23 +318,43 @@ class DeviceWeightCache:
             return len(self._trees)
 
     def evict(self, key) -> bool:
-        """Drop one entry (e.g. a rolled-back version); True if resident."""
+        """PURGE one entry from the device level AND the host tier (e.g.
+        a breaker-tripped version: known-bad weights must not survive in
+        any tier — a demotion here would hand the fault right back on
+        the next promotion); True if it was resident at either level.
+        LRU byte-pressure eviction demotes instead (see
+        ``_evict_to_budget``)."""
         with self._lock:
-            if key not in self._trees:
-                return False
-            del self._trees[key]
-            del self._nbytes[key]
-            self.evictions.append(key)
-            self.evictions_total += 1
-            return True
+            found = key in self._trees
+            if found:
+                del self._trees[key]
+                del self._nbytes[key]
+                self.evictions.append(key)
+                self.evictions_total += 1
+            self._payloads.pop(key, None)
+            fut = self._loading.get(key)
+            if fut is not None:
+                # A load for this key is IN FLIGHT: its result must not
+                # be cached when it lands (review finding — a breaker
+                # trip racing a demand fault used to re-admit the
+                # purged weights into both tiers).  The waiters still
+                # get their tree; it just is not retained.
+                fut["discard"] = True
+        if self.tier is not None:
+            # Outside the cache lock (no cache -> tier nesting).
+            found = self.tier.evict(key) or found
+        return found
 
     def clear(self) -> None:
-        """Empty the cache.  In-flight loads still resolve their waiters
-        (callers get a usable tree) but land in the NEW generation as
-        misses — a cleared cache stays cleared."""
+        """Empty the DEVICE level.  In-flight loads still resolve their
+        waiters (callers get a usable tree) but land in the NEW
+        generation as misses — a cleared cache stays cleared.  The host
+        tier is untouched (it has its own ``clear``): dropping staged
+        HBM must not cost the fleet its warm host copies."""
         with self._lock:
             self._trees.clear()
             self._nbytes.clear()
+            self._payloads.clear()
             self._gen += 1
 
     def bind_obs(self, metrics, name: str = "weight_cache") -> None:
@@ -224,6 +370,9 @@ class DeviceWeightCache:
             return {
                 "hits": self.hits,
                 "misses": self.misses,
+                "host_hits": self.host_hits,
+                "disk_loads": self.disk_loads,
+                "demotions": self.demotions,
                 "evictions": self.evictions_total,
                 "resident": len(self._trees),
                 "bytes_in_use": self._bytes_in_use(),

@@ -425,9 +425,20 @@ class SceneRegistry:
         health: HealthPolicy | None = HealthPolicy(),
         clock=time.perf_counter,
         obs: MetricsRegistry | None = None,
+        host_tier=None,
     ):
         self.manifest = manifest
-        self.cache = DeviceWeightCache(loader, budget_bytes, device)
+        # ``host_tier`` (a registry.hosttier.HostWeightTier) turns the
+        # device cache into the top of the three-tier weight hierarchy
+        # (DESIGN.md §17): LRU eviction demotes into compressed host
+        # RAM, re-admission promotes without disk IO, and a breaker
+        # trip's evict purges BOTH tiers.
+        self.host_tier = host_tier
+        self.cache = DeviceWeightCache(loader, budget_bytes, device,
+                                       tier=host_tier)
+        # Set once by attach_prefetcher (single-writer, documented
+        # call-order: attach before serving starts).
+        self._prefetcher = None
         self._fns: dict = {}
         self._fns_lock = threading.Lock()
         self._health_policy = health
@@ -453,6 +464,8 @@ class SceneRegistry:
         self.obs.register_collector("scene_health",
                                     self._health_collector)
         self.cache.bind_obs(self.obs)
+        if host_tier is not None:
+            host_tier.bind_obs(self.obs)
         self._health_lock = threading.Lock()
         # Deferred probes: (key, {leaf name: device array}) per dispatch.
         self._probes: collections.deque = collections.deque()
@@ -879,11 +892,11 @@ class SceneRegistry:
     def bind_obs(self, metrics: MetricsRegistry) -> None:
         """Adopt this registry's health instruments + collectors into
         ``metrics`` (a dispatcher's obs registry), so ONE fleet snapshot
-        covers serve accounting, scene health and the weight cache.  The
-        instrument OBJECTS are shared, not copied — both registries read
-        the same counts.  Idempotent; also safe across several
-        dispatchers over one SceneRegistry (each adopts the same
-        objects)."""
+        covers serve accounting, scene health, the weight cache, the
+        host tier and the prefetcher.  The instrument OBJECTS are
+        shared, not copied — both registries read the same counts.
+        Idempotent; also safe across several dispatchers over one
+        SceneRegistry (each adopts the same objects)."""
         if metrics is self.obs:
             return
         metrics.register(self._m_probe_frames)
@@ -891,6 +904,58 @@ class SceneRegistry:
         metrics.register(self._m_health_events)
         metrics.register_collector("scene_health", self._health_collector)
         self.cache.bind_obs(metrics)
+        if self.host_tier is not None:
+            self.host_tier.bind_obs(metrics)
+        if self._prefetcher is not None:
+            self._prefetcher.bind_obs(metrics)
+
+    # ------------- tiered weight hierarchy + prefetch (DESIGN.md §17) ----
+
+    def attach_prefetcher(self, policy=None, start: bool = True):
+        """Create (and by default start) the predictive
+        :class:`~esac_tpu.registry.prefetch.WeightPrefetcher` over this
+        registry.  Dispatchers built AFTERWARDS via :meth:`dispatcher`
+        feed it their per-scene arrival stream automatically
+        (``arrival_sink``); its decision counters ride ``obs`` as the
+        ``prefetch`` collector.  Attach once, before serving starts."""
+        from esac_tpu.registry.prefetch import PrefetchPolicy, WeightPrefetcher
+
+        if self._prefetcher is not None:
+            raise ValueError("a prefetcher is already attached")
+        pf = WeightPrefetcher(self, policy or PrefetchPolicy(),
+                              clock=self._clock)
+        self._prefetcher = pf
+        pf.bind_obs(self.obs)
+        if start:
+            pf.start()
+        return pf
+
+    def prefetch_targets(self, scene: str) -> list:
+        """The (scene, version) entries a prefetcher may stage for
+        ``scene``: the ACTIVE entry plus any in-flight canary's (a
+        canary's weights prefetch like any other version — its traffic
+        share faults exactly like active traffic), minus breaker-tripped
+        keys (the trip just PURGED those weights from both tiers;
+        re-staging them would undo the breaker).  Unknown scenes resolve
+        to [] — a misprediction, not an error."""
+        with self._health_lock:
+            canary = self._canaries.get(scene)
+            canary_version = canary["version"] if canary is not None else None
+            tripped = set(self._tripped)
+        out = []
+        try:
+            entry = self.manifest.resolve(scene)
+        except ManifestError:
+            entry = None
+        if entry is not None and entry.key not in tripped:
+            out.append(entry)
+        if canary_version is not None and \
+                (scene, canary_version) not in tripped:
+            try:
+                out.append(self.manifest.entry(scene, canary_version))
+            except ManifestError:
+                pass
+        return out
 
     def _resolve_serving(self, scene: str) -> SceneEntry:
         """Breaker- and canary-aware resolution: the manifest's active
@@ -975,6 +1040,12 @@ class SceneRegistry:
         over one SceneRegistry never alias each other's accounting)."""
         from esac_tpu.serve import MicroBatchDispatcher
 
+        if self._prefetcher is not None:
+            # Feed the predictive prefetcher this dispatcher's per-scene
+            # arrival stream (called OUTSIDE the dispatcher lock — the
+            # arrival_sink contract; observe() is a bounded non-blocking
+            # append).  Callers may override with their own sink.
+            kw.setdefault("arrival_sink", self._prefetcher.observe)
         disp = MicroBatchDispatcher(
             self.infer_fn(), cfg, start_worker=start_worker, **kw
         )

@@ -199,10 +199,19 @@ class MicroBatchDispatcher:
         slo: SLOPolicy | None = None,
         obs: MetricsRegistry | None = None,
         trace: bool = False,
+        arrival_sink=None,
     ):
         if stats_window < 1:
             raise ValueError(f"stats_window {stats_window} < 1")
         self._infer = infer_fn
+        # Per-scene arrival tap (DESIGN.md §17): ``arrival_sink(scene)``
+        # is called once per scene-carrying submission, OUTSIDE the
+        # dispatcher lock, BEFORE admission — the predictive weight
+        # prefetcher's feed (registry/prefetch.py).  The sink contract:
+        # non-blocking, never raises (WeightPrefetcher.observe is a
+        # bounded deque append).  Immutable post-init; None = no tap,
+        # zero cost beyond one attribute check.
+        self._arrival_sink = arrival_sink
         self._buckets = tuple(sorted(set(cfg.frame_buckets)))
         self._max_wait_s = cfg.serve_max_wait_ms / 1e3
         self._depth = cfg.serve_queue_depth
@@ -366,6 +375,10 @@ class MicroBatchDispatcher:
         the request carries ``deadline_ms`` (default
         ``slo.deadline_ms``)."""
         t_submit = self._clock()
+        if self._arrival_sink is not None and scene is not None:
+            # Arrival tap for the prefetcher: outside the lock, before
+            # admission — a shed request is still demand evidence.
+            self._arrival_sink(scene)
         # An EXPLICIT deadline_ms is honored with or without a policy —
         # silently ignoring a requested bound would reintroduce the
         # unbounded-blocking bug for exactly the caller who asked not to
@@ -481,6 +494,8 @@ class MicroBatchDispatcher:
             has_worker = self._worker is not None
         if not has_worker:
             t_submit = self._clock()
+            if self._arrival_sink is not None and scene is not None:
+                self._arrival_sink(scene)  # sync path: same tap as submit()
             if deadline_ms is None and self._slo is not None:
                 deadline_ms = self._slo.deadline_ms
             bounds = ([t_submit + deadline_ms / 1e3]
@@ -539,6 +554,9 @@ class MicroBatchDispatcher:
         import numpy as np
 
         t_submit = self._clock()
+        if self._arrival_sink is not None and scene is not None:
+            for _ in frames:  # bulk arrivals weigh their frame count
+                self._arrival_sink(scene)
         plan = plan_dispatches(len(frames), self._buckets)
         bounds = []
         lo = 0

@@ -287,6 +287,10 @@ def test_cache_stats_and_registry_health_shapes_pinned():
     assert set(cache.stats()) == {
         "hits", "misses", "evictions", "resident", "bytes_in_use",
         "budget_bytes", "load_failures", "loads_in_flight",
+        # Tier hierarchy classes (ISSUE 13, DESIGN.md §17): present —
+        # zero-valued — on tierless caches too, so monitors see one
+        # schema fleet-wide.
+        "host_hits", "disk_loads", "demotions",
     }
     reg = SceneRegistry(SceneManifest())
     h = reg.health()
