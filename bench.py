@@ -107,6 +107,20 @@ PREFETCH_HW = 24         # tiny frames: the sweep measures WEIGHT
 PREFETCH_M = 2
 PREFETCH_HYPS = 4
 
+FLEET_REPLICAS = 3       # serving replicas in the fleet bench
+FLEET_SCENES = 6         # scenes sharded over the replicas by affinity
+FLEET_M = 2              # experts per scene (tiny: the bench measures
+FLEET_HW = 24            # SCHEDULING — affinity, failover, accounting —
+FLEET_HYPS = 4           # not CNN throughput; cf. loadtest/chaos)
+FLEET_BUCKET = 2         # one frame bucket per replica dispatcher
+FLEET_ZIPF_A = 1.1       # scene-popularity skew of the arrival trace
+FLEET_MULTS = (0.4, 0.7, 1.0)  # offered load in multiples of the
+                               # AGGREGATE (n-replica) capacity for the
+                               # knee-vs-replica-count sweep
+FLEET_SECONDS = 1.5      # open-loop window per point
+FLEET_DRILL_RATE_X = 0.5  # drill load vs aggregate capacity — below the
+                          # knee, so every anomaly is the wedge's doing
+
 CHAOS_M = 2              # experts in the chaos drill's synthetic scenes
 CHAOS_HW = 24            # tiny frames: the drill measures FAULT routing
                          # and recovery, not throughput (cf. loadtest)
@@ -128,6 +142,7 @@ _SCORING_FILE = _REPO / ".scoring_fused.json"
 _CHAOS_FILE = _REPO / ".chaos_drill.json"
 _OBS_FILE = _REPO / ".obs_overhead.json"
 _PREFETCH_FILE = _REPO / ".weight_tiers.json"
+_FLEET_FILE = _REPO / ".fleet_serve.json"
 
 
 def _measure_jax(
@@ -1612,6 +1627,462 @@ def _measure_chaos_at(root: pathlib.Path, seconds: float) -> dict:
     }
 
 
+def _measure_fleet(seconds: float = FLEET_SECONDS) -> dict:
+    """Scene-affinity replica fleet bench (ISSUE 14, DESIGN.md §18):
+    a :class:`~esac_tpu.fleet.FleetRouter` over FLEET_REPLICAS
+    in-process dispatcher replicas — each with its own SceneRegistry +
+    weight cache over one shared manifest — measured three ways:
+
+    - **knee vs replica count**: the open-loop goodput knee
+      (loadtest semantics) at 1, 2 and 3 replicas under a Zipf scene
+      trace, offered in multiples of the AGGREGATE capacity — the
+      scale-out claim as a measured curve;
+    - **affinity**: the route mix (affinity / spill / cold) and the
+      per-replica weight-cache hit rates under the same Zipf trace at a
+      below-knee operating point — the 10x cold/warm gap is the prize,
+      the hit rate is the evidence the router collects it;
+    - **replica-wedge drill**: mid-load, one replica's dispatch path is
+      stalled via its tagged FaultInjector (every replica's injector is
+      armed with the SAME tag-matching predicate — only the target
+      fires, the others count ``dispatch_unmatched``); the dispatcher
+      watchdog converts the wedge to a typed DispatchStalledError, the
+      router quarantines the replica and fails its requests over
+      within their deadlines.  Reported: exact fleet accounting (every
+      request in exactly one outcome class, summing to offered),
+      healthy-scene goodput retention, failover p50/p99, the
+      failed-over result's bit-identity vs dispatching the surviving
+      replica directly, zero hot-path recompiles, and the lock-order
+      witness over the whole run.
+
+    Tiny scenes on purpose: the fleet bench measures SCHEDULING, not
+    CNN throughput (cf. loadtest/chaos).
+    """
+    import shutil
+    import tempfile
+
+    root = pathlib.Path(tempfile.mkdtemp(prefix="esac_fleet_"))
+    try:
+        return _measure_fleet_at(root, seconds)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _measure_fleet_at(root: pathlib.Path, seconds: float) -> dict:
+    import collections
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from esac_tpu.fleet import FleetPolicy, FleetRouter, Replica
+    from esac_tpu.models import ExpertNet, GatingNet
+    from esac_tpu.ransac import RansacConfig
+    from esac_tpu.registry import (
+        HealthPolicy, SceneEntry, SceneManifest, ScenePreset, SceneRegistry,
+        compute_entry_checksums,
+    )
+    from esac_tpu.serve import (
+        FaultInjector, MicroBatchDispatcher, SLOPolicy, poisson_arrivals,
+    )
+
+    H = W = FLEET_HW
+    M = FLEET_M
+    preset = ScenePreset(
+        height=H, width=W, num_experts=M,
+        stem_channels=(2, 4, 8), head_channels=8, head_depth=1,
+        gating_channels=(4,), compute_dtype="float32", gated=True,
+    )
+    cfg = RansacConfig(n_hyps=FLEET_HYPS, refine_iters=2, polish_iters=1,
+                       frame_buckets=(FLEET_BUCKET,), serve_max_wait_ms=2.0,
+                       serve_queue_depth=256)
+    hyps_per_request = M * FLEET_HYPS
+
+    expert = ExpertNet(
+        scene_center=(0.0, 0.0, 0.0), stem_channels=preset.stem_channels,
+        head_channels=preset.head_channels, head_depth=preset.head_depth,
+        compute_dtype=jnp.float32,
+    )
+    gating = GatingNet(num_experts=M, channels=preset.gating_channels,
+                       compute_dtype=jnp.float32)
+    img0 = jnp.zeros((1, H, W, 3))
+
+    def write_scene(name, seed):
+        e_params = jax.vmap(lambda k: expert.init(k, img0))(
+            jax.random.split(jax.random.key(seed), M)
+        )
+        centers = (np.asarray([[0.0, 0.0, 2.0]], np.float32)
+                   + np.arange(M, dtype=np.float32)[:, None] * 0.1)
+        d = root / name
+        from esac_tpu.utils.checkpoint import save_checkpoint
+
+        save_checkpoint(d / "expert", e_params, {
+            "stem_channels": list(preset.stem_channels),
+            "head_channels": preset.head_channels,
+            "head_depth": preset.head_depth,
+            "scene_centers": centers.tolist(),
+            "f": 40.0, "c": [W / 2.0, H / 2.0],
+        })
+        save_checkpoint(d / "gating",
+                        gating.init(jax.random.key(1000 + seed), img0),
+                        {"num_experts": M})
+        return compute_entry_checksums(SceneEntry(
+            scene_id=name, version=1,
+            expert_ckpt=str(d / "expert"), gating_ckpt=str(d / "gating"),
+            preset=preset, ransac=cfg,
+        ))
+
+    manifest = SceneManifest()
+    scenes = [f"s{i}" for i in range(FLEET_SCENES)]
+    for i, s in enumerate(scenes):
+        manifest.add(write_scene(s, seed=i))
+
+    def frame(i):
+        return {
+            "key": jax.random.fold_in(jax.random.key(7), i),
+            "image": np.asarray(jax.random.uniform(
+                jax.random.fold_in(jax.random.key(42), i), (H, W, 3)
+            )),
+        }
+
+    pool = [frame(i) for i in range(8)]
+
+    # ---- build the replicas: one registry + tagged injector + SLO
+    # dispatcher each (worker started after the lock witness attaches).
+    replicas, injectors, registries = [], {}, {}
+    for i in range(FLEET_REPLICAS):
+        name = f"r{i}"
+        reg = SceneRegistry(
+            manifest,
+            health=HealthPolicy(window=16, min_samples=4,
+                                trip_bad_frac=0.5),
+        )
+        inj = FaultInjector(reg.infer_fn(), tag=name)
+        disp = MicroBatchDispatcher(inj, cfg, start_worker=False)
+        reg.bind_obs(disp.obs)
+        replicas.append(Replica(name, disp, reg))
+        injectors[name] = inj
+        registries[name] = reg
+
+    # Prewarm every replica on every scene (sync path, pre-worker):
+    # weights loaded, ONE program compiled per registry — all compile
+    # cost off the measured path, and the jit cache-miss pin below has
+    # a clean baseline.
+    for rep in replicas:
+        for j, s in enumerate(scenes):
+            rep.dispatcher.infer_one(pool[j % len(pool)], scene=s)
+    compiled_before = sum(r.compile_cache_size()
+                          for r in registries.values())
+
+    # Closed-loop per-replica capacity (warm, bucket-sized dispatches).
+    walls = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        replicas[0].dispatcher.infer_many(pool[:FLEET_BUCKET],
+                                          scene=scenes[0])
+        walls.append(time.perf_counter() - t0)
+    dispatch_s = sorted(walls)[len(walls) // 2]
+    capacity_rps = FLEET_BUCKET / dispatch_s
+    deadline_ms = max(4_000.0, 30 * dispatch_s * 1e3)
+    watchdog_ms = max(500.0, 5 * dispatch_s * 1e3)
+    slo = SLOPolicy(deadline_ms=deadline_ms, watchdog_ms=watchdog_ms,
+                    retry_max=1, quarantine_after=2)
+    for rep in replicas:
+        rep.dispatcher._slo = slo  # sized from the measured dispatch
+
+    # graft-audit v3 runtime lock witness over the WHOLE fleet —
+    # attached before any worker/router thread starts (the witness
+    # contract), checked against the committed .lock_graph.json at the
+    # end, exactly like the chaos drill.
+    from esac_tpu.lint.witness import LockWitness
+
+    witness = LockWitness()
+    policy = FleetPolicy(poll_ms=5.0, replicate_share=0.3,
+                         replicate_min_requests=48)
+    router = FleetRouter(replicas, policy, start=False)
+    witness.attach_fleet(router=router)
+    for rep in replicas:
+        rep.dispatcher.start()
+    router.start()
+
+    zipf_p = 1.0 / np.arange(1, FLEET_SCENES + 1) ** FLEET_ZIPF_A
+    zipf_p /= zipf_p.sum()
+
+    def zipf_trace(n, seed):
+        return np.random.RandomState(seed).choice(
+            FLEET_SCENES, size=n, p=zipf_p
+        )
+
+    def open_loop(rtr, n, rate, seed):
+        """Submit a Zipf-scene Poisson trace open-loop; returns the
+        per-request FleetRequest records (the bench needs the requests
+        themselves for failover latency + bit-identity evidence) and
+        the per-request (scene, outcome, error type) triples."""
+        trace = zipf_trace(n, seed)
+        arrivals = poisson_arrivals(rate, n, seed=seed + 1)
+        t0 = time.perf_counter()
+        recs = []
+        for i in range(n):
+            target = t0 + float(arrivals[i])
+            while True:
+                now = time.perf_counter()
+                if now >= target:
+                    break
+                time.sleep(min(target - now, 0.01))
+            s = scenes[int(trace[i])]
+            fr = pool[i % len(pool)]
+            try:
+                req = rtr.submit(fr, scene=s, deadline_ms=deadline_ms)
+            except Exception as e:  # noqa: BLE001 — typed shed/expiry
+                from esac_tpu.serve import DeadlineExceededError
+
+                kind = ("expired" if isinstance(e, DeadlineExceededError)
+                        else "shed")
+                recs.append((s, fr, None, (kind, type(e).__name__)))
+                continue
+            recs.append((s, fr, req, None))
+        out = []
+        for s, fr, req, admitted_err in recs:
+            if req is None:
+                kind, errname = admitted_err
+                out.append((s, fr, None, kind, errname))
+                continue
+            req.event.wait(deadline_ms / 1e3 + 30.0)
+            err = type(req.error).__name__ if req.error is not None \
+                else None
+            out.append((s, fr, req, req.outcome or "lost", err))
+        return out
+
+    def leg_summary(recs, span_s):
+        outcomes = collections.Counter(o for _, _, _, o, _ in recs)
+        good = outcomes.get("served", 0) + outcomes.get("degraded", 0)
+        lat = sorted(
+            r.t_done - r.t_submit for _, _, r, o, _ in recs
+            if r is not None and o in ("served", "degraded")
+        )
+
+        def q(p):
+            if not lat:
+                return float("nan")
+            return lat[min(len(lat) - 1, round(p * (len(lat) - 1)))]
+
+        return {
+            "offered": len(recs),
+            "outcomes": dict(outcomes),
+            "goodput_ratio": round(good / max(len(recs), 1), 4),
+            "served_rps": round(good / max(span_s, 1e-9), 2),
+            "sustained_hyps_per_s": round(
+                good * hyps_per_request / max(span_s, 1e-9), 1),
+            "p50_ms": round(q(0.5) * 1e3, 2),
+            "p99_ms": round(q(0.99) * 1e3, 2),
+        }
+
+    # ---- leg A: aggregate knee vs replica count ----
+    knee_legs = []
+    for n_rep in range(1, FLEET_REPLICAS + 1):
+        sub = replicas[:n_rep]
+        points = []
+        for j, mult in enumerate(sorted(FLEET_MULTS)):
+            rtr = FleetRouter(sub, policy, start=True)
+            rate = mult * n_rep * capacity_rps
+            n = int(min(max(24, rate * seconds), 300))
+            t0 = time.perf_counter()
+            recs = open_loop(rtr, n, rate, seed=100 * n_rep + j)
+            span = time.perf_counter() - t0
+            totals = rtr.fleet_totals()
+            rtr.close(close_replicas=False)
+            point = {
+                "offered_x_aggregate_capacity": mult,
+                "offered_rps": round(rate, 2),
+                **leg_summary(recs, span),
+                "accounting_exact": (
+                    sum(totals[o] for o in
+                        ("served", "shed", "expired", "degraded",
+                         "failed")) + totals["pending"]
+                    == totals["offered"]
+                ),
+            }
+            points.append(point)
+        knee = _loadtest_knee(points)
+        knee_legs.append({
+            "replicas": n_rep,
+            "points": points,
+            "knee_offered_rps": knee["offered_rps"] if knee else None,
+            "knee_sustained_hyps_per_s":
+                knee["sustained_hyps_per_s"] if knee else None,
+        })
+
+    # ---- leg B: affinity under the Zipf trace (below the knee) ----
+    rtr = FleetRouter(replicas, policy, start=True)
+    for rep in replicas:
+        rep.dispatcher.reset_stats()
+    # Cache stats as DELTAS over the leg (stats() is the cache's locked
+    # snapshot): writing the counters to zero from here would race the
+    # worker threads' under-lock increments and mix prewarm-era counts
+    # into the leg's evidence (review finding).
+    cache_before = {name: reg.cache.stats()
+                    for name, reg in registries.items()}
+    rate = 0.5 * FLEET_REPLICAS * capacity_rps
+    n = int(min(max(48, rate * 2 * seconds), 400))
+    t0 = time.perf_counter()
+    recs = open_loop(rtr, n, rate, seed=7)
+    span = time.perf_counter() - t0
+    affinity = rtr.affinity_stats()
+    homes = {s: list(h) for s, h in rtr.scene_homes().items()}
+    cache_rates = {}
+    for name, reg in registries.items():
+        st = reg.cache.stats()
+        hits = st["hits"] - cache_before[name]["hits"]
+        misses = st["misses"] - cache_before[name]["misses"]
+        tot = hits + misses
+        cache_rates[name] = {
+            "hits": hits, "misses": misses,
+            "hit_rate": round(hits / tot, 4) if tot else None,
+        }
+    affinity_leg = {
+        "offered_rps": round(rate, 2),
+        **leg_summary(recs, span),
+        "route_mix": affinity,
+        "scene_homes": homes,
+        "replica_cache": cache_rates,
+        "zipf_a": FLEET_ZIPF_A,
+    }
+    rtr.close(close_replicas=False)
+
+    # ---- leg C: mid-load replica-wedge drill ----
+    # Seed affinity so the wedge target is a real home, then pick it.
+    for j, s in enumerate(scenes):
+        router.infer_one(pool[j % len(pool)], scene=s,
+                         deadline_ms=deadline_ms)
+    target = router.scene_homes()[scenes[0]][0]  # hottest scene's home
+    release = threading.Event()
+    for name, inj in injectors.items():
+        # The satellite contract: EVERY replica armed identically, the
+        # predicate picks exactly one — and only after a couple of its
+        # dispatches served, so the wedge lands MID-load.
+        inj.stall_once(release, after=2,
+                       match=lambda ctx, t=target: ctx["tag"] == t)
+    rate = FLEET_DRILL_RATE_X * FLEET_REPLICAS * capacity_rps
+    n = int(min(max(48, rate * 2 * seconds), 400))
+    t_arm = time.perf_counter()
+    recs = open_loop(router, n, rate, seed=23)
+    span = time.perf_counter() - t_arm
+    release.set()  # unwedge the abandoned worker (its gen is stale)
+    totals = router.fleet_totals()
+    accounting_exact = (
+        sum(totals[o] for o in ("served", "shed", "expired", "degraded",
+                                "failed")) + totals["pending"]
+        == totals["offered"]
+    )
+    quarantined = router.quarantined_replicas()
+    # Healthy scenes: homed off the wedged replica when the fault hit.
+    wedged_home_scenes = {s for s, h in router.scene_homes().items()
+                          if target in h}
+    healthy_recs = [r for r in recs if r[0] not in wedged_home_scenes]
+    healthy = leg_summary(healthy_recs, span)
+    drill = leg_summary(recs, span)
+    # Failover evidence: requests that faulted on the target and landed.
+    failed_over = [r for _, _, r, o, _ in recs
+                   if r is not None and r.failover_from
+                   and o in ("served", "degraded")]
+    fo_lat = sorted(r.t_done - r.t_faulted for r in failed_over)
+
+    def foq(p):
+        if not fo_lat:
+            return None
+        return round(
+            fo_lat[min(len(fo_lat) - 1, round(p * (len(fo_lat) - 1)))]
+            * 1e3, 2)
+
+    # Bit-identity: a failed-over result == the surviving replica
+    # dispatched directly with the same frame.
+    bit_identical = None
+    if failed_over:
+        probe = failed_over[0]
+        frame_used = next(fr for _, fr, r, _, _ in recs if r is probe)
+        direct = None
+        for rep in replicas:
+            if rep.name == probe.replica:
+                direct = rep.dispatcher.infer_one(
+                    frame_used, scene=probe.scene,
+                    deadline_ms=deadline_ms,
+                )
+        bit_identical = all(
+            np.array_equal(np.asarray(probe.result[k]),
+                           np.asarray(direct[k]))
+            for k in ("rvec", "tvec", "scores", "expert")
+        )
+    compiled_after = sum(r.compile_cache_size()
+                         for r in registries.values())
+    inj_stats = {name: inj.stats() for name, inj in injectors.items()}
+    obs_snapshot = router.obs.snapshot()
+    router.close(close_replicas=True)
+
+    from esac_tpu.lint.lockgraph import LOCK_GRAPH_NAME, load_graph
+
+    committed_graph = load_graph(_REPO / LOCK_GRAPH_NAME)
+    witness_snap = witness.snapshot()
+    violations = (witness.violations(committed_graph)
+                  if committed_graph is not None else None)
+
+    return {
+        "replicas": FLEET_REPLICAS,
+        "scenes": {"n": FLEET_SCENES, "hw": [H, W], "num_experts": M,
+                   "n_hyps": FLEET_HYPS, "frame_bucket": FLEET_BUCKET},
+        "closed_loop_dispatch_ms": round(dispatch_s * 1e3, 2),
+        "per_replica_capacity_rps": round(capacity_rps, 2),
+        "deadline_ms": round(deadline_ms, 1),
+        "watchdog_ms": round(watchdog_ms, 1),
+        "knee_vs_replicas": knee_legs,
+        "affinity": affinity_leg,
+        "wedge_drill": {
+            "wedged_replica": target,
+            "offered_rps": round(rate, 2),
+            "summary": drill,
+            "fleet_totals": totals,
+            "accounting_exact": bool(accounting_exact),
+            "quarantined": {k: v[:120] for k, v in quarantined.items()},
+            "healthy_scene_goodput_retention": healthy["goodput_ratio"],
+            "failed_over_requests": len(failed_over),
+            "failover_p50_ms": foq(0.5),
+            "failover_p99_ms": foq(0.99),
+            "failover_bit_identical": bit_identical,
+            "injector_stats": inj_stats,
+        },
+        "compiled_programs": {
+            "before_load": compiled_before,
+            "after_drill": compiled_after,
+            "hot_path_recompiles": compiled_after - compiled_before,
+        },
+        "lock_witness": {
+            "edges_observed": witness_snap["edges"],
+            "committed_graph_present": committed_graph is not None,
+            "violations": violations,
+            "observed_subgraph_of_committed": (
+                violations == [] if violations is not None else None
+            ),
+        },
+        "obs_snapshot": obs_snapshot,
+        "note": (
+            "open-loop Zipf scene trace over a scene-affinity replica "
+            "fleet; knee legs offered in multiples of aggregate "
+            "(n-replica) capacity; mid-load drill stalls ONE replica "
+            "via tag-matched FaultInjectors (the others count "
+            "dispatch_unmatched), the watchdog types the wedge, the "
+            "router quarantines the replica and fails its requests "
+            "over within their deadlines; fleet outcome classes sum "
+            "exactly to offered; failed-over results bit-identical to "
+            "the surviving replica dispatched directly; tiny scenes — "
+            "scheduling, not throughput.  NOTE on knee_vs_replicas: on "
+            "this 1-core container every replica shares one CPU, so "
+            "aggregate capacity saturates near the single-replica knee "
+            "— the leg demonstrates the MEASUREMENT (and that adding "
+            "replicas costs nothing); the scale-out number itself needs "
+            "one core/chip per replica (PARALLELISM.md)"
+        ),
+    }
+
+
 def _measure_obs(
     n_frames: int = OBS_FRAMES,
     n_hyps: int = OBS_HYPS,
@@ -1914,6 +2385,8 @@ def device_child(kwargs: dict) -> None:
         payload = {"obs": _measure_obs(**kwargs)}
     elif kwargs.pop("prefetch", False):
         payload = {"prefetch": _measure_prefetch(**kwargs)}
+    elif kwargs.pop("fleet", False):
+        payload = {"fleet": _measure_fleet(**kwargs)}
     else:
         payload = {"rate": _measure_jax(**kwargs)}
     import jax
@@ -2492,6 +2965,34 @@ def _prefetch_main(stopped: list[int], load_before: list[float]) -> None:
                  artifact_path=_PREFETCH_FILE, headline=_prefetch_headline)
 
 
+def _fleet_headline(fleet: dict) -> dict:
+    drill = fleet["wedge_drill"]
+    knees = {str(leg["replicas"]): leg["knee_sustained_hyps_per_s"]
+             for leg in fleet["knee_vs_replicas"]}
+    return {
+        "metric": "fleet_healthy_goodput_retention_under_wedge",
+        "value": drill["healthy_scene_goodput_retention"],
+        "unit": "goodput_ratio",
+        "vs_baseline": None,
+        "accounting_exact": drill["accounting_exact"],
+        "affinity_hit_rate": fleet["affinity"]["route_mix"]["hit_rate"],
+        "failover_p99_ms": drill["failover_p99_ms"],
+        "failover_bit_identical": drill["failover_bit_identical"],
+        "hot_path_recompiles":
+            fleet["compiled_programs"]["hot_path_recompiles"],
+        "knee_sustained_hyps_per_s_by_replicas": knees,
+    }
+
+
+def _fleet_main(stopped: list[int], load_before: list[float]) -> None:
+    """``python bench.py fleet`` — the ISSUE 14 scene-affinity replica
+    fleet bench (DESIGN.md §18) through the shared wedge-safe scaffold
+    (.fleet_serve.json)."""
+    _driver_main(stopped, load_before, key="fleet", what="fleet bench",
+                 measure_cpu=lambda: _measure_fleet(),
+                 artifact_path=_FLEET_FILE, headline=_fleet_headline)
+
+
 def _obs_main(stopped: list[int], load_before: list[float]) -> None:
     """``python bench.py obs`` — the ISSUE 10 observability overhead gate
     (DESIGN.md §14) through the shared scaffold (.obs_overhead.json)."""
@@ -2510,6 +3011,7 @@ def _main_measured(stopped: list[int], load_before: list[float]) -> None:
         "chaos": _chaos_main,
         "obs": _obs_main,
         "prefetch": _prefetch_main,
+        "fleet": _fleet_main,
     }
     if len(sys.argv) > 1 and sys.argv[1] in modes:
         modes[sys.argv[1]](stopped, load_before)

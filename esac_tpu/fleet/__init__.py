@@ -1,0 +1,31 @@
+"""Scene-affinity replica fleet: a fault-tolerant scheduler tier above
+the dispatchers (DESIGN.md §18).
+
+A :class:`FleetRouter` routes requests over N in-process
+:class:`~esac_tpu.serve.MicroBatchDispatcher` replicas — each with its
+own :class:`~esac_tpu.registry.SceneRegistry` and weight cache — with
+scene-affinity routing (the warm replica serves; spill to least-loaded
+on overload), per-replica health breakers composing with the per-scene
+ones (:class:`ReplicaQuarantinedError`, ``release_replica``), failover
+of a faulted replica's requests within their deadlines, obs-driven
+hot-scene replication, and fleet-level outcome accounting that sums
+exactly to offered.  Pure host package: importing it never touches jax.
+"""
+
+from esac_tpu.fleet.router import (
+    OUTCOMES,
+    FleetPolicy,
+    FleetRequest,
+    FleetRouter,
+    Replica,
+    ReplicaQuarantinedError,
+)
+
+__all__ = [
+    "OUTCOMES",
+    "FleetPolicy",
+    "FleetRequest",
+    "FleetRouter",
+    "Replica",
+    "ReplicaQuarantinedError",
+]

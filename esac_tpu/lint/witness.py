@@ -143,12 +143,16 @@ class LockWitness:
         return self
 
     def attach_fleet(self, disp=None, registry=None, injector=None,
-                     prefetcher=None) -> "LockWitness":
+                     prefetcher=None, router=None) -> "LockWitness":
         """One-call wiring for the shipped fleet shapes: a
         MicroBatchDispatcher (lock + conditions + its obs instruments),
         a SceneRegistry (health/program locks, manifest, weight cache +
         its host tier when attached, its obs registry), a
-        WeightPrefetcher, and optionally a FaultInjector.  The
+        WeightPrefetcher, a FleetRouter (ISSUE 14 — its lock, its obs
+        registry, and every replica's dispatcher + registry + a tagged
+        FaultInjector infer fn; attach BEFORE ``router.start()``, the
+        same contract as the dispatcher worker), and optionally a
+        FaultInjector.  The
         attach-before-start contract is ENFORCED for the prefetcher: an
         explicitly passed one whose thread is already running raises
         (rebuilding its Condition would strand the live waiter); an
@@ -180,6 +184,18 @@ class LockWitness:
             self.attach_obs(disp.obs)
         if injector is not None:
             self.attach(injector, "_lock")
+        if router is not None:
+            self.attach(router, "_lock")
+            self.attach_obs(router.obs)
+            for rep in router._replicas.values():
+                self.attach_fleet(
+                    disp=rep.dispatcher,
+                    registry=getattr(rep, "registry", None),
+                )
+                infer = getattr(rep.dispatcher, "_infer", None)
+                if infer is not None and hasattr(infer, "_lock") and \
+                        hasattr(infer, "stall_once"):
+                    self.attach(infer, "_lock")  # a tagged FaultInjector
         return self
 
     @staticmethod

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import random
 import threading
 import time
 
@@ -48,22 +49,40 @@ from esac_tpu.registry.manifest import (
 from esac_tpu.utils.checkpoint import load_checkpoint
 
 # Capped retry/backoff for transient checkpoint-read faults (OSError:
-# flaky NFS, a mid-rotation file, an interrupted read).  Two retries at
-# 50ms/100ms bound the added cold-load latency to ~150ms worst case —
-# small against the measured 29ms..seconds cold-load + compile costs —
-# while absorbing the single-blip faults that should never surface as a
-# failed dispatch.
+# flaky NFS, a mid-rotation file, an interrupted read).  Two retries
+# with a ~50ms base bound the added cold-load latency to well under a
+# second worst case — small against the measured 29ms..seconds
+# cold-load + compile costs — while absorbing the single-blip faults
+# that should never surface as a failed dispatch.
 LOAD_RETRIES = 2
 LOAD_BACKOFF_S = 0.05
+# Backoff ceiling, and the shared RNG behind the DECORRELATED JITTER
+# (ISSUE 14): the fleet tier puts N replicas in front of one store, and
+# PR 9's fixed 50ms/100ms ladder made their retries arrive in lockstep
+# — a retry storm that re-hits the faulted store at the exact same
+# instants.  Each retry now sleeps uniform(base, 3 * previous_sleep),
+# capped — the AWS "decorrelated jitter" schedule: successive sleeps
+# stay >= base, grow toward the cap on persistent faults, and N
+# replicas' retry instants decorrelate instead of synchronizing.  The
+# bounds (base <= sleep <= min(cap, 3 * prev)) and the unchanged typed
+# SceneLoadError contract are regression-pinned in
+# tests/test_registry_health.py.
+LOAD_BACKOFF_CAP_S = 1.0
+_BACKOFF_RNG = random.Random()
 
 
-def _read_with_retry(path, what, read_checkpoint, retries, backoff_s):
-    """``load_checkpoint`` with capped retry/backoff on transient IO
-    faults.  OSError is the transient class (retried); anything else —
-    an unparsable sidecar, a truncated Orbax tree — is deterministic and
-    wraps immediately into a typed, non-retryable SceneLoadError."""
+def _read_with_retry(path, what, read_checkpoint, retries, backoff_s,
+                     rng=None):
+    """``load_checkpoint`` with capped, decorrelated-jitter retry
+    backoff on transient IO faults.  OSError is the transient class
+    (retried); anything else — an unparsable sidecar, a truncated Orbax
+    tree — is deterministic and wraps immediately into a typed,
+    non-retryable SceneLoadError.  ``rng`` overrides the jitter source
+    (tests pin the bounds with a seeded Random)."""
     read = read_checkpoint if read_checkpoint is not None else load_checkpoint
+    uniform = (rng if rng is not None else _BACKOFF_RNG).uniform
     attempt = 0
+    sleep_s = backoff_s
     while True:
         try:
             return read(path)
@@ -74,7 +93,9 @@ def _read_with_retry(path, what, read_checkpoint, retries, backoff_s):
                     f"{what}: checkpoint {path!r} failed to load after "
                     f"{attempt} attempts (last: {e!r})"
                 ) from e
-            time.sleep(min(backoff_s * (2 ** (attempt - 1)), 1.0))
+            sleep_s = min(LOAD_BACKOFF_CAP_S,
+                          uniform(backoff_s, max(backoff_s, 3.0 * sleep_s)))
+            time.sleep(sleep_s)
         except (SceneLoadError, ManifestError):
             raise
         except Exception as e:  # noqa: BLE001 — typed boundary
@@ -105,6 +126,7 @@ def load_scene_params(
     retries: int = LOAD_RETRIES,
     backoff_s: float = LOAD_BACKOFF_S,
     read_checkpoint=None,
+    rng=None,
 ) -> dict:
     """Default weight-cache loader: checkpoint dirs -> one host param tree.
 
@@ -122,8 +144,11 @@ def load_scene_params(
     exhausted; when the entry carries content ``checksums``, the loaded
     tree+config must hash back to them or the load fails with a typed
     :class:`~esac_tpu.registry.health.ChecksumMismatchError` — corrupt
-    weights are never handed to a compiled program.  ``read_checkpoint``
-    overrides the checkpoint reader (the FaultInjector drill hook).
+    weights are never handed to a compiled program.  Retry sleeps carry
+    decorrelated jitter (see ``LOAD_BACKOFF_CAP_S``) so N replicas
+    faulting on one store never retry in lockstep; ``rng`` overrides
+    the jitter source.  ``read_checkpoint`` overrides the checkpoint
+    reader (the FaultInjector drill hook).
 
     The tree's leaves: ``expert`` (M-stacked variables), ``gating`` (gated
     presets only), ``centers`` (M, 3) per-expert scene centers, ``c`` (2,)
@@ -133,7 +158,7 @@ def load_scene_params(
     p = entry.preset
     what = f"{entry.scene_id} v{entry.version}"
     params_e, cfg_e = _read_with_retry(
-        entry.expert_ckpt, what, read_checkpoint, retries, backoff_s
+        entry.expert_ckpt, what, read_checkpoint, retries, backoff_s, rng
     )
     _verify_checksum(entry, "expert", params_e, cfg_e)
     for field in ("stem_channels", "head_channels", "head_depth"):
@@ -171,7 +196,7 @@ def load_scene_params(
     }
     if p.gated:
         params_g, cfg_g = _read_with_retry(
-            entry.gating_ckpt, what, read_checkpoint, retries, backoff_s
+            entry.gating_ckpt, what, read_checkpoint, retries, backoff_s, rng
         )
         _verify_checksum(entry, "gating", params_g, cfg_g)
         if int(cfg_g.get("num_experts", -1)) != p.num_experts:
@@ -662,28 +687,39 @@ class SceneRegistry:
             })
         return entry
 
-    def release_scene(self, scene_id: str, version: int | None = None) -> None:
+    def release_scene(self, scene_id: str, version: int | None = None) -> bool:
         """Operator override mirroring ``release_lane``: clear the
         breaker state (and stats) for a scene — one version or all — and
         cancel its in-flight canary, after the underlying fault (fixed
-        checkpoint, recovered storage) is resolved."""
+        checkpoint, recovered storage) is resolved.  Idempotent — a
+        double release is a no-op, and a release racing a concurrent
+        breaker trip is safe: the trip's deferred pointer/evict action
+        re-checks the tripped state before executing (see :meth:`_act`),
+        so an operator's "the weights are good" assertion is never
+        silently undone by a stale trip.  True when any breaker state
+        or canary was actually cleared."""
+        cleared = False
         with self._health_lock:
             for key in [k for k in self._tripped
                         if k[0] == scene_id
                         and (version is None or k[1] == version)]:
                 del self._tripped[key]
+                cleared = True
             for key in [k for k in self._samples
                         if k[0] == scene_id
                         and (version is None or k[1] == version)]:
                 del self._samples[key]
+                cleared = True
             c = self._canaries.get(scene_id)
             if c is not None and (version is None or c["version"] == version):
                 del self._canaries[scene_id]
+                cleared = True
                 self.health_events.append({
                     "t": self._clock(), "event": "canary_cancelled",
                     "scene": scene_id, "version": c["version"],
                     "incumbent": c["incumbent"],
                 })
+        return cleared
 
     def health(self, drain: bool = True) -> dict:
         """Locked snapshot of the breaker: per-(scene, version) window
@@ -839,36 +875,66 @@ class SceneRegistry:
         return None
 
     def _act(self, action) -> None:
-        """Execute one judged action (health lock NOT held — manifest and
-        cache take their own locks; single-shot guaranteed by the
-        state mutations _judge_locked already made)."""
+        """Execute one judged action (entered with the health lock NOT
+        held; single-shot guaranteed by the state mutations
+        _judge_locked already made).
+
+        Release-race guard (ISSUE 14 idempotence): a trip-derived
+        POINTER move executes inside the same health-locked critical
+        section as a tripped-state re-check — an operator's
+        ``release_scene`` landing in the judge->act window (their "the
+        fault is fixed" assertion) can therefore never be undone by a
+        stale rollback; the race is recorded as a
+        ``trip_release_raced`` event instead.  (health -> manifest is
+        a committed lock-graph edge, so the nesting is sanctioned;
+        SceneManifest.rollback is a pure in-memory pointer swap, not a
+        blocking call.)  The cache PURGE stays outside the health lock
+        (no health -> cache edge, by design) with its own last-instant
+        re-check: a release that slips into that final window costs at
+        most one cold reload of good weights on the next dispatch —
+        never a pointer move, never wrong results."""
         kind = action.pop("kind")
         scene, version = action["scene"], action["version"]
-        if kind == "auto_rollback":
-            try:
-                entry = self.manifest.rollback(scene)
-                self._record_event("auto_rollback", to_version=entry.version,
-                                   **action)
-            except ManifestError as e:
-                # Raced with an operator pointer move: degrade to a plain
-                # trip record — the version stays shed either way.
-                self._record_event("tripped", note=f"rollback lost: {e}",
-                                   **action)
-        elif kind == "tripped":
-            self._record_event("tripped", **action)
-        elif kind == "canary_rollback":
-            self._record_event("canary_rollback", **action)
-        elif kind == "canary_promote":
+        if kind in ("auto_rollback", "tripped", "canary_rollback"):
+            rolled_entry = rollback_exc = None
+            with self._health_lock:
+                still_tripped = (scene, version) in self._tripped
+                if still_tripped and kind == "auto_rollback":
+                    try:
+                        rolled_entry = self.manifest.rollback(scene)
+                    except ManifestError as e:
+                        rollback_exc = e
+            if not still_tripped:
+                self._record_event("trip_release_raced", **action)
+                return
+            if kind == "auto_rollback":
+                if rollback_exc is not None:
+                    # Raced with an operator pointer move: degrade to a
+                    # plain trip record — the version stays shed.
+                    self._record_event(
+                        "tripped", note=f"rollback lost: {rollback_exc}",
+                        **action)
+                else:
+                    self._record_event("auto_rollback",
+                                       to_version=rolled_entry.version,
+                                       **action)
+            else:
+                self._record_event(kind, **action)
+            if self._health_policy.evict_on_trip:
+                with self._health_lock:
+                    still_tripped = (scene, version) in self._tripped
+                if still_tripped:
+                    self.cache.evict((scene, version))
+            return
+        if kind == "canary_promote":
             try:
                 self.manifest.promote(scene, version)
                 self._record_event("canary_promoted", **action)
             except ManifestError as e:
                 self._record_event("canary_rollback",
                                    note=f"finalize lost: {e}", **action)
-                kind = "canary_rollback"
-        if self._health_policy.evict_on_trip and kind in (
-                "auto_rollback", "tripped", "canary_rollback"):
-            self.cache.evict((scene, version))
+                if self._health_policy.evict_on_trip:
+                    self.cache.evict((scene, version))
 
     def _record_event(self, kind: str, **fields) -> None:
         with self._health_lock:

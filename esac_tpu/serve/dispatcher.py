@@ -1117,14 +1117,21 @@ class MicroBatchDispatcher:
         with self._lock:
             return dict(self._quarantined)
 
-    def release_lane(self, scene=None, route_k=None) -> None:
+    def release_lane(self, scene=None, route_k=None) -> bool:
         """Operator action: clear a lane's quarantine + failure streak
         after the underlying fault (relay recovery, fixed weights) is
-        resolved.  New submissions to the lane are admitted again."""
+        resolved.  New submissions to the lane are admitted again.
+        Idempotent — a double release (two operators racing the same
+        runbook) is a no-op, and releasing a lane that a concurrent
+        watchdog/fail-streak trip is about to quarantine is safe: both
+        orders leave a consistent breaker state and exact accounting
+        (pinned in tests/test_serve_slo.py).  True when a quarantine
+        was actually cleared."""
         lane = (scene, route_k)
         with self._work:
-            self._quarantined.pop(lane, None)
+            was = self._quarantined.pop(lane, None)
             self._fail_streak.pop(lane, None)
+        return was is not None
 
     def reset_stats(self):
         """Clear the stat rings and outcome accounting.  ``offered`` is

@@ -182,6 +182,17 @@ class FaultInjector:
     stall from the worker thread's point of view) or :meth:`fail_times`
     (the next calls raise).  Unarmed calls pass straight through.
 
+    Per-replica targeting (ISSUE 14): each injector may carry a ``tag``
+    (the fleet drill tags one injector per replica with the replica
+    name), and the dispatch-path armings take an optional ``match``
+    predicate over the dispatch context ``{"tag", "scene", "route_k"}``
+    — so one drill recipe can arm every replica's injector identically
+    and still fault exactly one replica (or one scene on one replica)
+    without touching the others.  Unmatched armed calls pass through
+    untouched and are counted (``dispatch_unmatched`` in
+    :meth:`stats`), so the drill can assert the fault landed where it
+    aimed and nowhere else.
+
     Registry path: :meth:`checkpoint_reader` wraps a checkpoint-reading
     fn (``load_checkpoint``-shaped: path -> (params, config)), making
     every way a scene load can go bad drillable — :meth:`fail_loads`
@@ -199,17 +210,21 @@ class FaultInjector:
     stats stay readable while a dispatch or load is wedged.
     """
 
-    def __init__(self, infer_fn=None):
+    def __init__(self, infer_fn=None, tag=None):
         self._infer = infer_fn
+        self.tag = tag  # immutable identity (e.g. the replica name)
         self._cache_size = getattr(infer_fn, "_cache_size", None)
         self._lock = threading.Lock()
         self._stall_release: threading.Event | None = None
         self._stall_after = 0
+        self._stall_match = None
         self._fail_exc: Exception | None = None
         self._fail_left = 0
+        self._fail_match = None
         self._calls = 0
         self._stalls = 0
         self._failures = 0
+        self._unmatched = 0
         # Registry-path (checkpoint read) arming + counters.
         self._load_fail_exc: Exception | None = None
         self._load_fail_left = 0
@@ -224,18 +239,26 @@ class FaultInjector:
         self._load_corruptions = 0
         self._load_stalls = 0
 
-    def stall_once(self, release: threading.Event, after: int = 0) -> None:
-        """Arm ONE stall: the ``after``-th call from now blocks on
-        ``release`` (0 = the very next call)."""
+    def stall_once(self, release: threading.Event, after: int = 0,
+                   match=None) -> None:
+        """Arm ONE stall: the ``after``-th MATCHING call from now blocks
+        on ``release`` (0 = the very next one).  ``match`` is a
+        predicate over the dispatch context dict (``tag``/``scene``/
+        ``route_k``); None matches every call — the pre-ISSUE-14
+        contract, byte-for-byte."""
         with self._lock:
             self._stall_release = release
             self._stall_after = after
+            self._stall_match = match
 
-    def fail_times(self, exc: Exception, times: int = 1) -> None:
-        """Arm ``times`` consecutive failures raising ``exc``."""
+    def fail_times(self, exc: Exception, times: int = 1,
+                   match=None) -> None:
+        """Arm ``times`` consecutive MATCHING failures raising ``exc``
+        (``match`` as in :meth:`stall_once`)."""
         with self._lock:
             self._fail_exc = exc
             self._fail_left = times
+            self._fail_match = match
 
     # ---- registry-path (checkpoint read) arming ----
 
@@ -312,9 +335,11 @@ class FaultInjector:
     def stats(self) -> dict:
         with self._lock:
             return {
+                "tag": self.tag,
                 "calls": self._calls,
                 "stalls": self._stalls,
                 "failures": self._failures,
+                "dispatch_unmatched": self._unmatched,
                 "load_calls": self._load_calls,
                 "load_failures": self._load_failures,
                 "load_corruptions": self._load_corruptions,
@@ -322,21 +347,36 @@ class FaultInjector:
             }
 
     def __call__(self, tree, *rest):
+        ctx = {
+            "tag": self.tag,
+            "scene": rest[0] if rest else None,
+            "route_k": rest[1] if len(rest) > 1 else None,
+        }
         release = None
         with self._lock:
             self._calls += 1
-            if self._stall_release is not None:
+            armed = (self._stall_release is not None
+                     or self._fail_left > 0)
+            if self._stall_release is not None and (
+                    self._stall_match is None or self._stall_match(ctx)):
                 if self._stall_after <= 0:
                     release = self._stall_release
                     self._stall_release = None
                     self._stalls += 1
                 else:
                     self._stall_after -= 1
-            if release is None and self._fail_left > 0:
+            if release is None and self._fail_left > 0 and (
+                    self._fail_match is None or self._fail_match(ctx)):
                 self._fail_left -= 1
                 self._failures += 1
                 exc = self._fail_exc
                 raise exc
+            if release is None and armed:
+                # An armed fault existed but this call passed clean:
+                # either the predicate declined it, or the stall is
+                # still counting down.  The drill's "nowhere else"
+                # assertion reads this.
+                self._unmatched += 1
         if release is not None:
             release.wait()  # the wedge: held until the test releases it
         return self._infer(tree, *rest)

@@ -793,12 +793,195 @@ def test_all_mode_mains_share_the_wedge_safe_scaffold(monkeypatch):
     for main in (bench._serve_main, bench._registry_main,
                  bench._routed_main, bench._loadtest_main,
                  bench._scoring_main, bench._chaos_main,
-                 bench._obs_main, bench._prefetch_main):
+                 bench._obs_main, bench._prefetch_main,
+                 bench._fleet_main):
         main([], [0.0, 0.0, 0.0])
     assert [c[0] for c in calls] == [
         "serve", "registry", "routed", "loadtest", "scoring", "chaos",
-        "obs", "prefetch",
+        "obs", "prefetch", "fleet",
     ]
+
+
+# ---------------- fleet driver contract (ISSUE 14) ----------------
+
+def _canned_fleet():
+    """Minimal-but-complete fleet payload: the schema the driver and the
+    committed .fleet_serve.json artifact rely on."""
+    def point(mult, rps):
+        return {
+            "offered_x_aggregate_capacity": mult, "offered_rps": rps,
+            "offered": 100, "outcomes": {"served": 100},
+            "goodput_ratio": 1.0, "served_rps": rps,
+            "sustained_hyps_per_s": rps * 8, "p50_ms": 5.0,
+            "p99_ms": 20.0, "accounting_exact": True,
+        }
+
+    return {
+        "replicas": 3,
+        "scenes": {"n": 6, "hw": [24, 24], "num_experts": 2, "n_hyps": 4,
+                   "frame_bucket": 2},
+        "closed_loop_dispatch_ms": 2.0,
+        "per_replica_capacity_rps": 1000.0,
+        "deadline_ms": 4000.0, "watchdog_ms": 500.0,
+        "knee_vs_replicas": [
+            {"replicas": n, "points": [point(0.4, 400.0 * n)],
+             "knee_offered_rps": 400.0 * n,
+             "knee_sustained_hyps_per_s": 3200.0 * n}
+            for n in (1, 2, 3)
+        ],
+        "affinity": {
+            "offered_rps": 1500.0, **point(0.5, 1500.0),
+            "route_mix": {"affinity": 94, "spill": 0, "cold": 6,
+                          "dense": 0, "failover": 0, "hit_rate": 0.94},
+            "scene_homes": {"s0": ["r0"]},
+            "replica_cache": {"r0": {"hits": 10, "misses": 0,
+                                     "hit_rate": 1.0}},
+            "zipf_a": 1.1,
+        },
+        "wedge_drill": {
+            "wedged_replica": "r0", "offered_rps": 1500.0,
+            "summary": point(0.5, 1500.0),
+            "fleet_totals": {"offered": 100, "served": 100, "shed": 0,
+                             "expired": 0, "degraded": 0, "failed": 0,
+                             "pending": 0},
+            "accounting_exact": True,
+            "quarantined": {"r0": "wedge-class fault"},
+            "healthy_scene_goodput_retention": 1.0,
+            "failed_over_requests": 12,
+            "failover_p50_ms": 60.0, "failover_p99_ms": 120.0,
+            "failover_bit_identical": True,
+            "injector_stats": {
+                "r0": {"tag": "r0", "stalls": 1, "failures": 0,
+                       "dispatch_unmatched": 0},
+                "r1": {"tag": "r1", "stalls": 0, "failures": 0,
+                       "dispatch_unmatched": 5},
+            },
+        },
+        "compiled_programs": {"before_load": 3, "after_drill": 3,
+                              "hot_path_recompiles": 0},
+        "lock_witness": {"edges_observed": {
+            "FleetRouter._lock->CounterVec._lock": 10,
+            "MicroBatchDispatcher._lock->CounterVec._lock": 10,
+        }, "committed_graph_present": True, "violations": [],
+            "observed_subgraph_of_committed": True},
+        "obs_snapshot": {"obs_schema": 1, "metrics": {}, "collectors": {}},
+        "note": "canned",
+    }
+
+
+def test_fleet_main_emits_one_json_line_and_artifact(tmp_path, monkeypatch,
+                                                     capsys):
+    """The driver contract: ONE parseable JSON line, headline = healthy
+    goodput retention under the wedge, the affinity/failover/recompile
+    acceptance fields surfaced, and the .fleet_serve.json artifact with
+    platform + recorded_at + obs provenance."""
+    monkeypatch.setattr(bench, "_FLEET_FILE", tmp_path / "fleet.json")
+    monkeypatch.setattr(
+        bench, "measure_on_device",
+        lambda *a, **k: {"fleet": _canned_fleet(), "platform": "tpu",
+                         "device_kind": "fake-tpu"},
+    )
+    bench._fleet_main([], [0.0, 0.0, 0.0])
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1, f"expected ONE JSON line, got {len(lines)}"
+    out = json.loads(lines[0])
+    assert out["metric"] == "fleet_healthy_goodput_retention_under_wedge"
+    assert out["value"] == 1.0
+    assert out["unit"] == "goodput_ratio"
+    assert "vs_baseline" in out
+    assert out["accounting_exact"] is True
+    assert out["affinity_hit_rate"] == 0.94
+    assert out["failover_bit_identical"] is True
+    assert out["hot_path_recompiles"] == 0
+    assert out["knee_sustained_hyps_per_s_by_replicas"] == {
+        "1": 3200.0, "2": 6400.0, "3": 9600.0,
+    }
+    assert "contention" in out
+    artifact = json.loads((tmp_path / "fleet.json").read_text())
+    assert artifact["platform"] == "tpu"
+    assert "recorded_at" in artifact
+    # The fleet payload embeds its obs snapshot -> provenance says so.
+    assert artifact["obs_provenance"]["has_fleet_snapshot"] is True
+
+
+def test_fleet_cpu_fallback_carries_provenance(tmp_path, monkeypatch,
+                                               capsys):
+    """Relay wedged -> the fleet bench measures on CPU and SAYS so."""
+    monkeypatch.setattr(bench, "_FLEET_FILE", tmp_path / "fleet.json")
+    monkeypatch.setattr(bench, "measure_on_device", lambda *a, **k: None)
+    monkeypatch.setattr(bench, "_measure_fleet",
+                        lambda *a, **k: _canned_fleet())
+    bench._fleet_main([], [0.0, 0.0, 0.0])
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1
+    out = json.loads(lines[0])
+    assert "CPU" in out["note"] or "cpu" in out["note"]
+    artifact = json.loads((tmp_path / "fleet.json").read_text())
+    assert artifact["platform"] == "cpu"
+    assert artifact["note"] == out["note"]
+
+
+def test_fleet_artifact_schema_committed():
+    """The committed .fleet_serve.json satisfies the ISSUE 14 acceptance
+    schema: the injected mid-load wedge converted to a typed quarantine
+    + failover with every request in exactly ONE outcome class and
+    fleet accounting summing exactly to offered (per point and for the
+    drill), healthy-scene goodput >= 0.99 through the fault, failover
+    results bit-identical to the surviving replica's direct dispatch,
+    zero hot-path recompiles, the affinity-hit rate reported under the
+    Zipf trace, and per-replica accounting sums in the embedded fleet
+    snapshot."""
+    import pathlib
+
+    path = pathlib.Path(bench.__file__).parent / ".fleet_serve.json"
+    if not path.exists():
+        import pytest
+
+        pytest.skip("no committed fleet artifact yet")
+    artifact = json.loads(path.read_text())
+    for key in ("metric", "value", "unit", "platform", "recorded_at",
+                "fleet"):
+        assert key in artifact, key
+    fleet = artifact["fleet"]
+    for leg in fleet["knee_vs_replicas"]:
+        for p in leg["points"]:
+            assert sum(p["outcomes"].values()) == p["offered"], p
+            assert p["accounting_exact"] is True
+    drill = fleet["wedge_drill"]
+    t = drill["fleet_totals"]
+    assert (t["served"] + t["shed"] + t["expired"] + t["degraded"]
+            + t["failed"] + t["pending"] == t["offered"])
+    assert drill["accounting_exact"] is True
+    assert drill["healthy_scene_goodput_retention"] >= 0.99
+    assert drill["wedged_replica"] in drill["quarantined"]
+    assert drill["failed_over_requests"] >= 1
+    assert drill["failover_p99_ms"] is not None
+    assert drill["failover_bit_identical"] is True
+    # The injected fault landed on exactly ONE replica: the target
+    # stalled once, every other armed injector only counted unmatched.
+    stats = drill["injector_stats"]
+    assert stats[drill["wedged_replica"]]["stalls"] == 1
+    for name, s in stats.items():
+        if name != drill["wedged_replica"]:
+            assert s["stalls"] == 0 and s["failures"] == 0
+    assert fleet["compiled_programs"]["hot_path_recompiles"] == 0
+    assert 0.0 < fleet["affinity"]["route_mix"]["hit_rate"] <= 1.0
+    # Runtime lock witness rode the bench, violation-free.
+    lw = fleet["lock_witness"]
+    assert lw["committed_graph_present"] is True
+    assert lw["violations"] == []
+    assert lw["observed_subgraph_of_committed"] is True
+    assert any(k.startswith("FleetRouter._lock->")
+               for k in lw["edges_observed"]), lw["edges_observed"]
+    # Per-replica-labelled fleet merge in the embedded obs snapshot,
+    # each replica's own books summing exactly.
+    snap = fleet["obs_snapshot"]
+    if snap.get("collectors", {}).get("fleet"):
+        for block in snap["collectors"]["fleet"]["replicas"].values():
+            s = block["slo"]
+            assert (s["served"] + s["shed"] + s["expired"] + s["degraded"]
+                    + s["failed"] + s["pending"] == s["offered"])
+    assert artifact["obs_provenance"]["has_fleet_snapshot"] is True
 
 
 # ---------------- obs driver contract (ISSUE 10) ----------------

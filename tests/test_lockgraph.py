@@ -419,15 +419,23 @@ def test_r13_name_collision_still_walks_both_classes(tmp_path):
 # --------------------------------------------------------------------------
 # the repo's own fleet map
 
-def test_repo_fleet_lock_map_is_exactly_the_committed_five_edges():
+def test_repo_fleet_lock_map_is_exactly_the_committed_eight_edges():
     """Pin the REAL fleet's lock-order graph edge-for-edge (DESIGN.md
     §15): dispatcher → {counter, histogram-vec, streaming-histogram}
     (accounting published inside the dispatch critical sections),
-    registry health → counter (_record_event), and health → manifest
-    (_judge_locked's rollback-target reads).  A new lock domain or a
-    new nesting MUST show up here as a reviewed diff, not as drift."""
+    registry health → counter (_record_event), health → manifest
+    (_judge_locked's rollback-target reads), and — since ISSUE 14's
+    replica-fleet tier (DESIGN.md §18) — the FleetRouter's lock over
+    the same obs-instrument leaves, mirroring the dispatcher's pattern
+    (fleet books counted inside the router's critical sections; never
+    over a dispatcher or registry lock — replica snapshots and submits
+    happen outside).  A new lock domain or a new nesting MUST show up
+    here as a reviewed diff, not as drift."""
     g = build_graph(REPO)
     assert _edge_pairs(g) == {
+        ("FleetRouter._lock", "CounterVec._lock"),
+        ("FleetRouter._lock", "HistogramVec._lock"),
+        ("FleetRouter._lock", "StreamingHistogram._lock"),
         ("MicroBatchDispatcher._lock", "CounterVec._lock"),
         ("MicroBatchDispatcher._lock", "HistogramVec._lock"),
         ("MicroBatchDispatcher._lock", "StreamingHistogram._lock"),
@@ -446,13 +454,14 @@ def test_repo_fleet_lock_map_is_exactly_the_committed_five_edges():
 
 def test_lock_pass_changed_mode_skip_condition():
     """--changed skips the (fleet-global) lock pass unless a
-    serve/registry/obs/lint file changed — the jaxpr-layer skip,
-    mirrored."""
+    serve/registry/obs/fleet/lint file changed — the jaxpr-layer skip,
+    mirrored.  ISSUE 14: the replica-fleet tier is in scope."""
     assert lock_pass_needed(None)
     assert lock_pass_needed(["esac_tpu/serve/dispatcher.py"])
     assert lock_pass_needed(["esac_tpu/registry/cache.py"])
     assert lock_pass_needed(["esac_tpu/obs/metrics.py"])
     assert lock_pass_needed(["esac_tpu/lint/lockgraph.py"])
+    assert lock_pass_needed(["esac_tpu/fleet/router.py"])
     assert not lock_pass_needed(
         ["esac_tpu/geometry/pnp.py", "bench.py", "LINT.md",
          "tests/test_serve.py"]

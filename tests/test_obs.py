@@ -322,6 +322,59 @@ def test_scene_registry_binds_into_dispatcher_obs():
         is reg.obs.get("registry_health_events_total")
 
 
+def test_fleet_snapshot_per_replica_merge_shape_pinned():
+    """ISSUE 14: a FleetRouter's ``obs.snapshot()`` carries the
+    per-replica-labelled fleet merge — every replica's serve accounting
+    under its name, the affinity table, the route counts and the fleet
+    accounting — json-dumpable, shapes pinned (the driver/monitor
+    contract, like the cache/health shapes above)."""
+    import numpy as np
+
+    from esac_tpu.fleet import FleetPolicy, FleetRouter, Replica
+
+    def echo(tree, scene=None, route_k=None):
+        return {"echo": tree["x"]}
+
+    reps = [
+        Replica(f"r{i}", MicroBatchDispatcher(echo, CFG, slo=SLOPolicy()))
+        for i in range(2)
+    ]
+    router = FleetRouter(reps, FleetPolicy(poll_ms=2.0))
+    try:
+        for i in range(4):
+            router.infer_one({"x": np.full(2, float(i), np.float32)},
+                             scene=f"s{i % 2}", deadline_ms=5_000)
+        snap = router.obs.snapshot()
+        json.dumps(snap)
+        assert "fleet" in snap["collectors"]
+        fleet = snap["collectors"]["fleet"]
+        assert set(fleet) == {"replicas", "scene_homes", "route_counts",
+                              "accounting"}
+        assert set(fleet["replicas"]) == {"r0", "r1"}
+        for block in fleet["replicas"].values():
+            assert set(block) == {"slo", "quarantined", "inflight"}
+            assert set(block["slo"]) == {"offered", "served", "shed",
+                                         "expired", "degraded", "failed",
+                                         "pending"}
+        acc = fleet["accounting"]
+        assert set(acc) == {"offered", "served", "shed", "expired",
+                            "degraded", "failed", "pending"}
+        assert (acc["served"] + acc["shed"] + acc["expired"]
+                + acc["degraded"] + acc["failed"] + acc["pending"]
+                == acc["offered"] == 4)
+        # The fleet instruments ride the same registry.
+        assert {"fleet_offered_total", "fleet_outcomes_total",
+                "fleet_routes_total", "fleet_failovers_total",
+                "fleet_events_total", "fleet_request_latency_seconds",
+                "fleet_failover_seconds"} <= set(snap["metrics"])
+        # Routes are per-replica-labelled.
+        routes = snap["metrics"]["fleet_routes_total"]["samples"]
+        assert all("replica" in s["labels"] and "kind" in s["labels"]
+                   for s in routes)
+    finally:
+        router.close()
+
+
 # ---------------- open-loop per-lane views (satellite 2) --------------
 
 def test_run_open_loop_reports_per_scene_and_per_route_quantiles():

@@ -933,3 +933,120 @@ def test_cache_clear_is_not_resurrected_by_inflight_load():
     assert cache.keys() == []
     cache.get(E())                      # next get is a clean miss
     assert len(cache) == 1
+
+
+# ---------------- ISSUE 14 satellites: jitter + release idempotence ----
+
+def test_load_retry_backoff_decorrelated_jitter_bounds(monkeypatch):
+    """The retry backoff carries decorrelated jitter: each sleep is in
+    [base, min(cap, 3 * previous)], sleeps VARY (N replicas faulting on
+    one store must not retry in lockstep), the cap binds, and the typed
+    SceneLoadError contract is byte-for-byte the PR-9 one."""
+    import random
+
+    from esac_tpu.registry import serving
+
+    sleeps = []
+    monkeypatch.setattr(serving.time, "sleep", lambda s: sleeps.append(s))
+
+    def bad_read(path):
+        raise OSError("flaky store")
+
+    with pytest.raises(SceneLoadError) as ei:
+        serving._read_with_retry("/x", "a v1", bad_read, retries=6,
+                                 backoff_s=0.05, rng=random.Random(0))
+    assert "failed to load after 7 attempts" in str(ei.value)
+    assert isinstance(ei.value.__cause__, OSError)
+    assert len(sleeps) == 6
+    prev = 0.05
+    for s in sleeps:
+        assert 0.05 - 1e-12 <= s <= min(serving.LOAD_BACKOFF_CAP_S,
+                                        3.0 * prev) + 1e-12, (s, prev)
+        prev = s
+    assert len({round(s, 9) for s in sleeps}) > 1  # jittered, not a ladder
+    # Cap binds with a large base.
+    sleeps.clear()
+    with pytest.raises(SceneLoadError):
+        serving._read_with_retry("/x", "a v1", bad_read, retries=4,
+                                 backoff_s=0.9, rng=random.Random(1))
+    assert sleeps and all(
+        0.9 - 1e-12 <= s <= serving.LOAD_BACKOFF_CAP_S for s in sleeps
+    )
+
+
+def test_load_scene_params_rng_passthrough_and_retry_success(scenes,
+                                                            monkeypatch):
+    """``load_scene_params(rng=...)`` rides the seeded jitter source and
+    a single transient blip still loads transparently."""
+    import random
+
+    from esac_tpu.registry import serving
+
+    sleeps = []
+    monkeypatch.setattr(serving.time, "sleep", lambda s: sleeps.append(s))
+    fails = {"n": 1}
+
+    def flaky(path):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise OSError("blip")
+        return load_checkpoint(path)
+
+    tree = load_scene_params(scenes[1], read_checkpoint=flaky,
+                             rng=random.Random(7))
+    assert set(tree) >= {"expert", "centers", "c", "f"}
+    assert len(sleeps) == 1
+    assert 0.05 - 1e-12 <= sleeps[0] <= 0.15 + 1e-12  # [base, 3*base]
+
+
+def test_release_scene_idempotent_and_reports():
+    """Double release is a safe no-op (False); releasing a tripped
+    scene reports True once and the breaker state is fully cleared."""
+    outputs = {1: _out(bad=True)}
+    reg, serve = _stub_registry(outputs, n_versions=1)
+    assert reg.release_scene("s") is False  # nothing to clear
+    for _ in range(8):
+        try:
+            serve({}, "s")
+        except SceneUnhealthyError:
+            break
+    assert reg.health()["scenes"]["s@v1"]["tripped"] is not None
+    outputs[1] = _out()  # the operator's fix
+    assert reg.release_scene("s") is True
+    assert reg.release_scene("s") is False  # double release: no-op
+    serve({}, "s")  # serves again
+    assert reg.health()["scenes"]["s@v1"]["tripped"] is None
+
+
+def test_release_racing_a_trip_wins_and_accounting_stays_exact():
+    """ISSUE 14 idempotence: an operator release landing in the breaker's
+    judge -> act window WINS — the stale trip neither moves the pointer
+    nor purges the just-blessed weights, the race is recorded typed
+    (``trip_release_raced``), and the scene keeps serving."""
+    outputs = {1: _out(bad=True)}
+    reg, serve = _stub_registry(outputs, n_versions=1)
+    real_act = reg._act
+    raced = []
+
+    def racing_act(action):
+        # The operator's release lands AFTER the judge mutated trip
+        # state but BEFORE the deferred action executes.
+        outputs[1] = _out()
+        reg.release_scene("s")
+        raced.append(dict(action))
+        real_act(action)
+
+    reg._act = racing_act
+    evicted = []
+    real_evict = reg.cache.evict
+    reg.cache.evict = lambda key: (evicted.append(key),
+                                   real_evict(key))[1]
+    for _ in range(8):
+        serve({}, "s")  # never sheds: the release always wins the race
+    assert raced, "the breaker never judged a trip"
+    events = [e["event"] for e in reg.health()["events"]]
+    assert "trip_release_raced" in events
+    assert "tripped" not in events  # the stale trip never committed
+    assert evicted == []            # blessed weights never purged
+    assert reg.health()["scenes"].get("s@v1", {}).get("tripped") is None
+    serve({}, "s")  # still serving

@@ -632,6 +632,59 @@ def test_repeated_dispatch_failures_quarantine_the_lane():
     assert t["failed"] == 2 and t["shed"] == 1 and t["served"] == 1
 
 
+def test_fault_injector_match_predicate_targets_one_tag():
+    """ISSUE 14: dispatch-path armings take a match predicate over
+    {tag, scene, route_k}, so a fleet drill arms every replica's
+    injector identically and faults exactly one — unmatched armed
+    calls pass through untouched and are counted."""
+    inj_a = FaultInjector(_echo, tag="rA")
+    inj_b = FaultInjector(_echo, tag="rB")
+    pick_b = lambda ctx: ctx["tag"] == "rB"  # noqa: E731
+    for inj in (inj_a, inj_b):
+        inj.fail_times(RuntimeError("targeted"), times=1, match=pick_b)
+    out = inj_a(_frame(1.0), "s0")  # armed but unmatched: passes clean
+    assert out["echo"][0] == 1.0
+    with pytest.raises(RuntimeError, match="targeted"):
+        inj_b(_frame(2.0), "s0")
+    assert inj_a.stats()["failures"] == 0
+    assert inj_a.stats()["dispatch_unmatched"] == 1
+    assert inj_a.stats()["tag"] == "rA"
+    assert inj_b.stats()["failures"] == 1
+    assert inj_b.stats()["dispatch_unmatched"] == 0
+    # Scene-scoped stall predicate: only the matching scene wedges.
+    release = threading.Event()
+    release.set()  # pre-released: the call records the stall, no hang
+    inj_b.stall_once(release, match=lambda ctx: ctx["scene"] == "hot")
+    inj_b(_frame(), "cold")
+    assert inj_b.stats()["stalls"] == 0
+    inj_b(_frame(), "hot")
+    assert inj_b.stats()["stalls"] == 1
+
+
+def test_release_lane_idempotent_and_reports():
+    """ISSUE 14 operator-surface idempotence: double release is a safe
+    no-op (returns False), release of a never-quarantined lane is too,
+    and accounting stays exact throughout."""
+    inj = FaultInjector(_echo)
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,), serve_max_wait_ms=0.0)
+    disp = MicroBatchDispatcher(inj, cfg,
+                                slo=SLOPolicy(retry_max=0,
+                                              quarantine_after=1))
+    assert disp.release_lane(scene="never") is False
+    inj.fail_times(RuntimeError("boom"), times=1)
+    with pytest.raises(RuntimeError):
+        disp.infer_one(_frame(), scene="s", timeout=5.0)
+    assert disp.quarantined_lanes() != {}
+    assert disp.release_lane(scene="s") is True
+    assert disp.release_lane(scene="s") is False  # double release
+    assert disp.quarantined_lanes() == {}
+    out = disp.infer_one(_frame(4.0), scene="s", timeout=5.0)
+    assert out["echo"][0] == 4.0
+    disp.close()
+    t = _totals_consistent(disp)
+    assert t["failed"] == 1 and t["served"] == 1
+
+
 # ---------------- open-loop load generation ----------------
 
 def test_arrival_schedules_deterministic_and_rate_true():
