@@ -1797,8 +1797,13 @@ def _measure_fleet_at(root: pathlib.Path, seconds: float) -> dict:
     from esac_tpu.lint.witness import LockWitness
 
     witness = LockWitness()
+    # trace_sample=8: ALWAYS-ON sampled causal tracing across every leg
+    # (ISSUE 15 — the obs gate bounds full-rate tracing at <= 3%, and
+    # 1-in-8 divides it); the embedded obs snapshot's ``traces``
+    # collector carries the slowest sampled traces as artifact
+    # exemplars.
     policy = FleetPolicy(poll_ms=5.0, replicate_share=0.3,
-                         replicate_min_requests=48)
+                         replicate_min_requests=48, trace_sample=8)
     router = FleetRouter(replicas, policy, start=False)
     witness.attach_fleet(router=router)
     for rep in replicas:
@@ -2016,6 +2021,24 @@ def _measure_fleet_at(root: pathlib.Path, seconds: float) -> dict:
                          for r in registries.values())
     inj_stats = {name: inj.stats() for name, inj in injectors.items()}
     obs_snapshot = router.obs.snapshot()
+    # Sampled-trace evidence (ISSUE 15): the drill router's ring of
+    # completed traces — exemplar slow traces ride the artifact, and
+    # every sampled trace must telescope exactly at fleet scope.
+    store = router.obs.get_trace_store()
+    drill_traces = [t for t in store.traces() if t.done] \
+        if store is not None else []
+    trace_evidence = {
+        "sample_1_in": policy.trace_sample,
+        "sampled": len(drill_traces),
+        "max_abs_residual_s": (max(t.residual() for t in drill_traces)
+                               if drill_traces else None),
+        "telescoping_exact": bool(
+            drill_traces
+            and max(t.residual() for t in drill_traces) < 1e-6
+        ),
+        "exemplar_slow_traces": (store.slowest(3)
+                                 if store is not None else []),
+    }
     router.close(close_replicas=True)
 
     from esac_tpu.lint.lockgraph import LOCK_GRAPH_NAME, load_graph
@@ -2048,6 +2071,7 @@ def _measure_fleet_at(root: pathlib.Path, seconds: float) -> dict:
             "failover_p99_ms": foq(0.99),
             "failover_bit_identical": bit_identical,
             "injector_stats": inj_stats,
+            "traces": trace_evidence,
         },
         "compiled_programs": {
             "before_load": compiled_before,
@@ -2217,6 +2241,8 @@ def _measure_obs(
     compiled_after = dispw.cache_size()
     dispw.close()
 
+    fleet = _measure_obs_fleet(fn, cfg, frames, repeats)
+
     ratio_wall = med(pair_ratios)      # on-wall / off-wall, pair-median
     ratio = 1.0 / ratio_wall           # on-throughput / off-throughput
     overhead_pct = (ratio_wall - 1.0) * 100.0
@@ -2242,6 +2268,7 @@ def _measure_obs(
         },
         "stage_p50_ms": stage_p50_ms,
         "snapshot_json_ok": snapshot_json_ok,
+        "fleet": fleet,
         "obs_snapshot": snapshot,
         "note": (
             "same compiled program for every leg; off/on passes "
@@ -2253,6 +2280,206 @@ def _measure_obs(
             "attributed to the stage REACHED (the 'served' row is the "
             "sliced->finish fan-out gap); span residual is the "
             "telescoping-sum check over every traced request"
+        ),
+    }
+
+
+def _measure_obs_fleet(fn, cfg, frames, repeats: int) -> dict:
+    """ISSUE 15: the obs gate's FLEET leg — the same pair-median 3%
+    protocol, lifted through a :class:`~esac_tpu.fleet.FleetRouter`
+    over 2 replicas sharing ONE compiled program, with the full ISSUE
+    15 stack on in the traced leg: 1-in-1 trace sampling, the windowed
+    timeline, and the health-rule engine driven from the router's
+    completion loop.  Gates the artifact carries:
+
+    - tracing+timeline-on throughput within 3% of off (median of
+      per-pair wall ratios, same protocol as the single-dispatcher
+      legs);
+    - ZERO additional compiled programs across the whole fleet sweep
+      (tracing/timeline/rules are pure host bookkeeping);
+    - the FLEET telescoping sum: every sampled trace's root segments —
+      router overhead + replica span(s) (+ failover siblings) — fsum
+      EXACTLY to its end-to-end latency, including across a forced
+      watchdog-failover re-dispatch (the drill wedges one replica via a
+      tag-matched FaultInjector, the router fails the traced request
+      over, and the trace must still telescope with the two dispatch
+      spans linked ``retry_of``);
+    - the timeline ring stays within its bound and a healthy sweep
+      raises no alerts.
+    """
+    from esac_tpu.fleet import FleetPolicy, FleetRouter, Replica
+    from esac_tpu.serve import (
+        FaultInjector, MicroBatchDispatcher, SLOPolicy,
+    )
+
+    # The replicas share fn's ONE jitted program; scenes ride as pure
+    # routing labels (the serve fn is scene-blind, the jit cache-miss
+    # pin below is what proves no program ever recompiled).
+    def scene_blind(tree, scene=None, route_k=None):
+        return fn(tree)
+
+    scene_blind._cache_size = fn._cache_size
+    compiled_before = fn._cache_size()
+    slo = SLOPolicy(deadline_ms=120_000.0)
+    dispatchers = [MicroBatchDispatcher(scene_blind, cfg, slo=slo)
+                   for _ in range(2)]
+    replicas = [Replica(f"r{i}", d) for i, d in enumerate(dispatchers)]
+    scenes = [f"s{i}" for i in range(4)]
+
+    def fleet_pass(traced: bool):
+        policy = FleetPolicy(poll_ms=2.0,
+                             trace_sample=1 if traced else 0)
+        router = FleetRouter(replicas, policy, start=True)
+        if traced:
+            router.obs.attach_timeline(window_s=0.05, max_windows=240)
+            router.obs.attach_health_rules()
+        t0 = time.perf_counter()
+        reqs = [
+            router.submit(frames[i % len(frames)],
+                          scene=scenes[i % len(scenes)],
+                          deadline_ms=120_000.0)
+            for i in range(len(frames))
+        ]
+        for r in reqs:
+            r.get(300.0)
+        dt = time.perf_counter() - t0
+        return dt, router
+
+    import gc
+
+    offs, ons = [], []
+    last_on_router = None
+    for _ in range(repeats):
+        gc.collect()
+        dt, router = fleet_pass(False)
+        router.close(close_replicas=False)
+        offs.append(dt)
+        gc.collect()
+        dt, router = fleet_pass(True)
+        ons.append(dt)
+        if last_on_router is not None:
+            last_on_router.close(close_replicas=False)
+        last_on_router = router  # kept open: telescoping/timeline evidence
+
+    # Telescoping + timeline + alert evidence from the LAST traced pass.
+    store = last_on_router.obs.get_trace_store()
+    traces = [t for t in store.traces() if t.done]
+    residuals = [t.residual() for t in traces]
+    tl = last_on_router.obs.timeline()
+    tl.tick()  # close the trailing partial window
+    eng = last_on_router.obs.health_rules()
+    eng.evaluate()
+    tl_snap = tl.snapshot()
+    alerts = eng.snapshot()
+    exemplars = store.slowest(3)
+    last_on_router.close(close_replicas=False)
+
+    # Failover drill: wedge replica r0 via a tag-matched injector, let
+    # the watchdog type the stall, and require the failed-over traced
+    # request to STILL telescope exactly, failover siblings included.
+    drill_slo = SLOPolicy(deadline_ms=120_000.0, watchdog_ms=250.0,
+                          watchdog_poll_ms=10.0)
+    injectors = [FaultInjector(scene_blind, tag=f"f{i}") for i in range(2)]
+    drill_disps = [MicroBatchDispatcher(inj, cfg, slo=drill_slo)
+                   for inj in injectors]
+    drill_reps = [Replica(f"f{i}", d) for i, d in enumerate(drill_disps)]
+    drill_router = FleetRouter(
+        drill_reps, FleetPolicy(poll_ms=2.0, trace_sample=1), start=True,
+    )
+    import threading
+
+    # Seed the scene's home on f0 (cold placement prefers the name-tie
+    # winner on an idle fleet), then wedge exactly f0.
+    drill_router.infer_one(frames[0], scene="drill", deadline_ms=60_000.0)
+    home = drill_router.scene_homes()["drill"][0]
+    release = threading.Event()
+    for inj in injectors:
+        inj.stall_once(release,
+                       match=lambda ctx, t=home: ctx["tag"] == t)
+    fo_result = drill_router.infer_one(frames[1], scene="drill",
+                                       deadline_ms=60_000.0)
+    release.set()
+    fo_traces = [t for t in drill_router.obs.get_trace_store().traces()
+                 if t.done and len([s for s in t.spans
+                                    if s.kind == "dispatch"]) > 1]
+    drill_router.close(close_replicas=True)
+    fo = None
+    if fo_traces:
+        t = fo_traces[-1]
+        dsp = [s for s in t.spans if s.kind == "dispatch"]
+        fo = {
+            "checked": True,
+            "served": fo_result is not None,
+            "residual_s": t.residual(),
+            "sums_match_e2e": bool(t.residual() < 1e-6),
+            "root_stages": [s for s, _ in t.root.segments()],
+            "dispatch_spans": len(dsp),
+            "retry_linked": bool(
+                dsp[-1].annotations.get("retry_of") == dsp[0].span_id
+            ),
+            "wedged_replica": home,
+        }
+
+    compiled_after = fn._cache_size()
+
+    def med(xs):
+        return sorted(xs)[len(xs) // 2]
+
+    pair_ratios = sorted(on / off for off, on in zip(offs, ons))
+    ratio_wall = med(pair_ratios)
+    n_frames = len(frames)
+
+    def leg(walls):
+        m = med(walls)
+        return {
+            "wall_s_median": round(m, 4),
+            "wall_s_spread": [round(x, 4) for x in sorted(walls)],
+            "requests_per_s": round(n_frames / m, 1),
+        }
+
+    max_resid = max(residuals) if residuals else None
+    return {
+        "replicas": 2,
+        "n_frames": n_frames,
+        "repeats": repeats,
+        "tracing_off": leg(offs),
+        "tracing_on": leg(ons),
+        "overhead_pct": round((ratio_wall - 1.0) * 100.0, 2),
+        "pair_wall_ratios": [round(r, 4) for r in pair_ratios],
+        "throughput_ratio_on_over_off": round(1.0 / ratio_wall, 4),
+        "within_3pct": bool(1.0 / ratio_wall >= 0.97),
+        "jit_cache_misses_added": compiled_after - compiled_before,
+        "telescoping": {
+            "traces_checked": len(traces),
+            "max_abs_residual_s": max_resid,
+            "sums_match_e2e": bool(residuals
+                                   and max(residuals) < 1e-6),
+            "failover": fo,
+        },
+        "timeline": {
+            "ticks": tl_snap["ticks"],
+            "windows_retained": tl_snap["windows_retained"],
+            "ring_bounded": bool(
+                tl_snap["windows_retained"] <= tl_snap["max_windows"]
+            ),
+        },
+        "alerts": {
+            "rules": alerts["rules"],
+            "events": len(alerts["events"]),
+            "quiet": not alerts["active"],
+        },
+        "exemplar_slow_traces": exemplars,
+        "note": (
+            "2 in-process replicas over ONE shared compiled program; "
+            "traced leg = 1-in-1 trace sampling + 50ms timeline windows "
+            "+ the default rule catalog driven from the router loop; "
+            "pair-median protocol as the single-dispatcher legs; "
+            "telescoping = every sampled trace's root segments (router "
+            "overhead + replica spans + failover siblings) fsum to its "
+            "end-to-end latency; the failover drill wedges the scene's "
+            "home replica via tag-matched injectors and the watchdog, "
+            "and the failed-over trace must telescope with its two "
+            "dispatch spans linked retry_of"
         ),
     }
 
@@ -2924,6 +3151,8 @@ def _chaos_main(stopped: list[int], load_before: list[float]) -> None:
 
 
 def _obs_headline(obs: dict) -> dict:
+    fleet = obs.get("fleet") or {}
+    fo = (fleet.get("telescoping") or {}).get("failover") or {}
     return {
         "metric": "obs_tracing_overhead_pct",
         "value": obs["overhead_pct"],
@@ -2934,6 +3163,15 @@ def _obs_headline(obs: dict) -> dict:
             obs["compiled_programs"]["jit_cache_misses_added"],
         "span_sums_match_e2e": obs["span_integrity"]["sums_match_e2e"],
         "snapshot_json_ok": obs["snapshot_json_ok"],
+        # ISSUE 15 fleet leg: tracing+timeline through a FleetRouter.
+        "fleet_overhead_pct": fleet.get("overhead_pct"),
+        "fleet_within_3pct": fleet.get("within_3pct"),
+        "fleet_jit_cache_misses_added":
+            fleet.get("jit_cache_misses_added"),
+        "fleet_telescoping_ok": (
+            (fleet.get("telescoping") or {}).get("sums_match_e2e")
+            and fo.get("sums_match_e2e")
+        ),
     }
 
 

@@ -78,7 +78,7 @@ import dataclasses
 import threading
 import time
 
-from esac_tpu.obs import MetricsRegistry
+from esac_tpu.obs import MetricsRegistry, Trace
 from esac_tpu.serve.slo import (
     DeadlineExceededError,
     DispatcherClosedError,
@@ -175,8 +175,17 @@ class FleetPolicy:
     # Rebalancer cadence, and the arrival-window length it judges over.
     rebalance_every_s: float = 0.25
     arrivals_window: int = 512
+    # Causal-trace sampling (ISSUE 15, DESIGN.md §19): 0 = tracing off;
+    # N >= 1 mints a fleet Trace for every Nth submission (1 = every
+    # request).  Sampling is what makes ALWAYS-ON tracing viable: the
+    # per-request cost is gated at <= 3% by `python bench.py obs` at
+    # N=1, and 1-in-N divides it.  Sampled traces land in the obs
+    # registry's ring-bounded TraceStore (`traces` collector).
+    trace_sample: int = 0
 
     def __post_init__(self):
+        if self.trace_sample < 0:
+            raise ValueError(f"trace_sample {self.trace_sample} < 0")
         if self.poll_ms <= 0:
             raise ValueError(f"poll_ms {self.poll_ms} <= 0")
         if self.failover_max < 0:
@@ -207,7 +216,7 @@ class FleetRequest:
     __slots__ = ("frame", "scene", "route_k", "deadline", "t_submit",
                  "event", "result", "error", "outcome", "t_done", "done",
                  "replica", "ureq", "attempts", "failover_from",
-                 "t_faulted", "owner", "_key")
+                 "t_faulted", "owner", "_key", "trace", "_last_span")
 
     def __init__(self, frame, scene, route_k, deadline, t_submit, owner):
         self.frame = frame
@@ -228,6 +237,9 @@ class FleetRequest:
         self.t_faulted = None      # first replica-fault instant
         self.owner = owner
         self._key = None           # router _pending key (set at submit)
+        self.trace = None          # sampled obs.Trace, or None
+        self._last_span = None     # last dispatch child span (failover
+        #                            siblings link through it: retry_of)
 
     def get(self, timeout: float | None = None):
         """Wait up to ``timeout`` seconds; raises the request's typed
@@ -330,6 +342,11 @@ class FleetRouter:
             window=100_000,
         )
         self.obs.register_collector("fleet", self.fleet_view)
+        # Sampled causal traces (ISSUE 15): the ring-bounded store is
+        # created only when sampling is on, so an untraced fleet's
+        # snapshot schema is unchanged.
+        self._trace_store = (self.obs.trace_store()
+                             if policy.trace_sample else None)
         self._thread = None
         if start:
             self.start()
@@ -419,6 +436,15 @@ class FleetRouter:
             self._seq += 1
             req._key = self._seq
             self._pending[req._key] = req
+            n = self._policy.trace_sample
+            if n and self._seq % n == 0:
+                # Mint the fleet trace (1-in-N deterministic sampling).
+                # The root chain lives in THIS router's clock: its
+                # consecutive stamps partition [t_submit, t_done] into
+                # routing / replica / failover_routing segments whose
+                # fsum equals the end-to-end span EXACTLY — the §14
+                # telescoping invariant at fleet scope (bench-pinned).
+                req.trace = Trace(t_submit, scene=scene, sampled_1_in=n)
         try:
             self._dispatch_to_replica(req, exclude=set())
         except DeadlineExceededError as e:
@@ -484,9 +510,15 @@ class FleetRouter:
             remaining_ms = (None if req.deadline is None
                             else (req.deadline - now) * 1e3)
             try:
+                kw = {}
+                if req.trace is not None:
+                    # Trace context rides into the replica: its request
+                    # gets a child chain + the registry fault path sees
+                    # the trace, whatever the dispatcher's own flag.
+                    kw["trace_ctx"] = req.trace
                 ureq = rep.dispatcher.submit(
                     req.frame, scene=req.scene, route_k=req.route_k,
-                    deadline_ms=remaining_ms,
+                    deadline_ms=remaining_ms, **kw,
                 )
             except (DispatcherClosedError, WorkerDiedError) as e:
                 # The replica itself is unroutable: breaker bookkeeping,
@@ -522,6 +554,17 @@ class FleetRouter:
                     self._load[name] += 1
                     self._route_counts[kind] += 1
                     self._m_routes.inc(replica=name, kind=kind)
+                    if req.trace is not None:
+                        # Root boundary: time up to here is router
+                        # overhead (routing / failover_routing); the
+                        # routing DECISION rides as an event span.
+                        t = self._clock()
+                        req.trace.stamp(
+                            "failover_routing" if req.failover_from
+                            else "routing", t,
+                        )
+                        req.trace.add_event("route_decision", t,
+                                            replica=name, route_kind=kind)
                     return
             rep.dispatcher._abandon(ureq, stale_err or
                                     DeadlineExceededError(
@@ -625,6 +668,14 @@ class FleetRouter:
             self._m_latency.observe(req.t_done - req.t_submit)
             if req.t_faulted is not None:
                 self._m_failover_s.observe(req.t_done - req.t_faulted)
+        if req.trace is not None:
+            # Terminal root stamp in the SAME clock and with the SAME
+            # instant as the fleet accounting, so the trace's total is
+            # bit-equal to the measured end-to-end latency; publication
+            # into the store is a leaf-lock deque append (R13-clean).
+            req.trace.finish(outcome, req.t_done)
+            if self._trace_store is not None:
+                self._trace_store.add(req.trace)
         req.event.set()
 
     # ---------------- completion loop ----------------
@@ -645,6 +696,17 @@ class FleetRouter:
             if now >= next_rebalance:
                 self._rebalance()
                 next_rebalance = now + self._policy.rebalance_every_s
+            # Drive the time-series + rule layers between polls (ISSUE
+            # 15): both are piggyback hooks — one clock compare when not
+            # due — and both run with NO router lock held (timeline
+            # aggregation takes instrument locks one at a time, rule
+            # evaluation reads the timeline's locked window snapshot).
+            tl = self.obs.timeline()
+            if tl is not None:
+                tl.maybe_tick()
+                eng = self.obs.health_rules()
+                if eng is not None:
+                    eng.maybe_evaluate()
             time.sleep(poll)
 
     def _settle(self, req: FleetRequest) -> None:
@@ -659,6 +721,25 @@ class FleetRouter:
                 return
             req.ureq = None
             self._load[req.replica] -= 1
+            if req.trace is not None:
+                # Child dispatch span: the underlying request's chain
+                # (ITS clock domain — it telescopes on its own) under
+                # the fleet root; failover siblings link via retry_of.
+                sp = req.trace.add_span(
+                    f"replica:{req.replica}", "dispatch",
+                    ureq.t_submit, ureq.t_done,
+                    stages=(ureq.spans.segments()
+                            if ureq.spans is not None else None),
+                    replica=req.replica, outcome=ureq.outcome,
+                    retry_of=(req._last_span.span_id
+                              if req._last_span is not None else None),
+                )
+                req._last_span = sp
+                # Root boundary (router clock): the replica segment ends
+                # when the completion loop CONSUMED it — poll latency is
+                # router overhead charged to the replica segment
+                # honestly, not hidden.
+                req.trace.stamp("replica", self._clock())
             err = ureq.error
             if err is None:
                 self._fail_streak.pop(req.replica, None)
@@ -692,6 +773,10 @@ class FleetRouter:
             req.t_faulted = now
         req.attempts += 1
         req.failover_from.append(from_name)
+        if req.trace is not None:
+            req.trace.add_event("replica_fault", now, replica=from_name,
+                                error=type(err).__name__,
+                                attempt=req.attempts)
         if req.deadline is not None and now >= req.deadline:
             with self._lock:
                 self._finish_locked(req, error=DeadlineExceededError(
@@ -750,7 +835,11 @@ class FleetRouter:
         if reason is None:
             return
         disp = self._replicas[name].dispatcher
-        for _r, ureq in victims:
+        t_quar = self._clock()
+        for r, ureq in victims:
+            if r.trace is not None:
+                r.trace.add_event("replica_quarantined", t_quar,
+                                  replica=name)
             disp._abandon(ureq, ReplicaQuarantinedError(
                 f"replica {name!r} quarantined ({reason}); request "
                 "failed over"

@@ -131,15 +131,21 @@ class LockWitness:
 
     def attach_obs(self, metrics) -> "LockWitness":
         """Wrap a :class:`~esac_tpu.obs.MetricsRegistry`'s own lock plus
-        every registered instrument's lock and every EXISTING histogram
-        child's.  Children created after attach stay unwrapped — their
-        acquisitions simply go unobserved, which only shrinks the
-        observed set (the subgraph check is one-sided)."""
+        every registered instrument's lock, every EXISTING histogram
+        child's, and — when attached (ISSUE 15) — the trace store's,
+        the timeline's and the rule engine's leaf locks.  Children
+        created after attach stay unwrapped — their acquisitions simply
+        go unobserved, which only shrinks the observed set (the
+        subgraph check is one-sided)."""
         self.attach(metrics, "_lock")
         for inst in list(metrics._metrics.values()):
             self.attach(inst, "_lock")
             for child in list(getattr(inst, "_children", {}).values()):
                 self.attach(child, "_lock")
+        for attachment in (metrics._trace_store, metrics._timeline,
+                           metrics._health_rules):
+            if attachment is not None:
+                self.attach(attachment, "_lock")
         return self
 
     def attach_fleet(self, disp=None, registry=None, injector=None,

@@ -1,14 +1,22 @@
-"""Fleet observability (DESIGN.md §14): tracing, metrics, export.
+"""Fleet observability (DESIGN.md §14/§19): tracing, metrics, export.
 
 Observability as a LAYER, not another ring buffer: one
 :class:`MetricsRegistry` that the dispatcher, SLO layer, scene registry,
 health breakers and weight cache all publish into; request-scoped
 :class:`SpanChain` tracing stamped at the dispatcher's existing choke
 points (gated — the hot path with tracing off is unchanged, and with it
-on gains zero host syncs and zero jit interactions); and one export
-surface — a locked ``json.dumps``-able ``snapshot()``, a
-Prometheus-style text page, the ``python -m esac_tpu.obs`` dump CLI and
-the ``python bench.py obs`` overhead gate behind ``.obs_overhead.json``.
+on gains zero host syncs and zero jit interactions); fleet-wide causal
+:class:`Trace` records tying the FleetRouter, replica dispatchers and
+the registry's weight-fault path together under one sampled trace id
+(ring-bounded :class:`TraceStore`, the ``traces`` collector); a
+ring-bounded windowed :class:`~esac_tpu.obs.timeline.Timeline` giving
+every collector a time axis; a declarative health
+:class:`~esac_tpu.obs.rules.RuleEngine` over it; and one export surface
+— a locked ``json.dumps``-able ``snapshot()``, a Prometheus-style text
+page (every collector's numeric leaves included), the ``python -m
+esac_tpu.obs`` dump CLI (``--traces`` renders the K slowest sampled
+traces) and the ``python bench.py obs`` overhead gate behind
+``.obs_overhead.json``.
 
 Pure host package: importing it never touches jax or the TPU relay.
 """
@@ -22,19 +30,45 @@ from esac_tpu.obs.metrics import (
     MetricsRegistry,
     StreamingHistogram,
 )
-from esac_tpu.obs.trace import SpanChain, STAGES, TERMINAL_STAGES
+from esac_tpu.obs.rules import Alert, RuleEngine, default_rules
+from esac_tpu.obs.timeline import Timeline
+from esac_tpu.obs.trace import (
+    STAGES,
+    Span,
+    SpanChain,
+    TERMINAL_STAGES,
+    Trace,
+    TraceStore,
+    active_traces,
+    current_issuer,
+    issuer_scope,
+    new_trace_id,
+    trace_scope,
+)
 
 __all__ = [
     "OBS_SCHEMA",
+    "Alert",
     "CounterVec",
     "GaugeVec",
     "HistogramVec",
     "MetricsRegistry",
+    "RuleEngine",
+    "Span",
     "SpanChain",
     "STAGES",
     "StreamingHistogram",
     "TERMINAL_STAGES",
+    "Timeline",
+    "Trace",
+    "TraceStore",
+    "active_traces",
+    "current_issuer",
+    "default_rules",
+    "issuer_scope",
     "jsonable",
+    "new_trace_id",
     "provenance",
     "render_prometheus",
+    "trace_scope",
 ]

@@ -1,7 +1,9 @@
 """``python -m esac_tpu.obs`` — dump a fleet snapshot.
 
-Reads an obs snapshot and renders it as Prometheus text (default) or
-pretty JSON.  Sources, in order:
+Reads an obs snapshot and renders it as Prometheus text (default,
+every collector's numeric leaves included as samples), pretty JSON, or
+— with ``--traces [K]`` — the K slowest sampled causal traces (span
+tree + per-stage durations, ISSUE 15).  Sources, in order:
 
 - ``--file PATH``: a JSON file that is either a bare ``snapshot()`` dict
   (has a ``metrics`` key) or a bench artifact carrying one (the
@@ -80,6 +82,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--format", choices=("prom", "json"), default="prom")
     ap.add_argument("--demo", action="store_true",
                     help="run a tiny in-process CPU fleet and dump it")
+    ap.add_argument("--traces", type=int, nargs="?", const=5, default=None,
+                    metavar="K",
+                    help="render the K slowest sampled traces (default 5) "
+                         "instead of the metrics page")
     args = ap.parse_args(argv)
 
     if args.demo:
@@ -101,7 +107,11 @@ def main(argv: list[str] | None = None) -> int:
                   "obs.obs_snapshot)", file=sys.stderr)
             return 2
 
-    if args.format == "json":
+    if args.traces is not None:
+        from esac_tpu.obs.export import render_traces
+
+        sys.stdout.write(render_traces(snap, args.traces))
+    elif args.format == "json":
         print(json.dumps(snap, indent=1, sort_keys=True))
     else:
         from esac_tpu.obs.export import render_prometheus

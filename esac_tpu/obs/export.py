@@ -19,6 +19,37 @@ import math
 
 from esac_tpu.obs.metrics import OBS_SCHEMA
 
+# Every collector the shipped fleet registers, with the key fields its
+# rendered block must carry — the SCHEMA PIN (ISSUE 15 satellite): the
+# audit test (tests/test_obs.py) builds a full fleet and asserts the
+# registered collector set is covered here, so the NEXT collector
+# cannot land unrendered — adding it to a surface forces adding it (and
+# its load-bearing fields) to this table, and the renderer below
+# flattens every entry's numeric leaves into real Prometheus samples.
+KNOWN_COLLECTORS = {
+    # dispatcher (PR 10)
+    "serve_slo_totals": ("offered", "served", "pending"),
+    "serve_dispatch_totals": (),          # lane -> count (dynamic keys)
+    "serve_quarantined_lanes": (),        # lane -> reason (non-numeric)
+    # scene registry / health (PR 9/10)
+    "scene_health": (),                   # scenes/canaries/events
+    "weight_cache": ("hits", "misses", "host_hits", "disk_loads",
+                     "demotions", "resident", "bytes_in_use"),
+    # tier hierarchy + prefetcher (ISSUE 13)
+    "host_tier": ("hits", "misses", "admissions", "resident",
+                  "bytes_in_use"),
+    "prefetch": ("issued_device", "issued_host", "hits", "wasted",
+                 "failures", "cycles"),
+    # replica fleet (ISSUE 14)
+    "fleet": (),                          # per-replica merge (dynamic)
+    # runtime lock witness (graft-audit v3; test/bench attach only)
+    "lock_witness": (),
+    # ISSUE 15: causal traces, time axis, health rules
+    "traces": ("added", "retained"),
+    "timeline": ("ticks", "windows_retained", "window_s"),
+    "health_alerts": (),
+}
+
 
 def jsonable(obj):
     """Recursively convert ``obj`` into something ``json.dumps`` accepts:
@@ -75,9 +106,15 @@ def _prom_value(v) -> str:
 def render_prometheus(snapshot: dict) -> str:
     """Prometheus text exposition of a :meth:`MetricsRegistry.snapshot`
     dict.  Counters/gauges render directly; histograms render as
-    summaries (quantile-labeled samples + ``_count``/``_sum``).
-    Structured collector blocks are not flattenable into samples and are
-    listed as comments so the page still names every surface."""
+    summaries (quantile-labeled samples + ``_count``/``_sum``);
+    EVERY collector block's numeric leaves render as
+    ``esac_collector_value{collector=...,path=...}`` samples (the
+    ISSUE 15 satellite: prefetch / host_tier / weight_cache / fleet /
+    lock_witness stats are scrapeable numbers, not comments — and the
+    generic flattener means the next collector renders by
+    construction, with :data:`KNOWN_COLLECTORS` as the reviewed pin);
+    a collector with no numeric leaf still appears as a comment so the
+    page names every surface."""
     lines = [f"# esac_tpu obs schema {snapshot.get('obs_schema')}"]
     for name, m in sorted(snapshot.get("metrics", {}).items()):
         kind = m.get("kind", "untyped")
@@ -109,9 +146,71 @@ def render_prometheus(snapshot: dict) -> str:
                     f"{name}{_prom_labels(labels)} "
                     f"{_prom_value(s.get('value'))}"
                 )
-    for cname in sorted(snapshot.get("collectors", {})):
-        lines.append(f"# COLLECTOR {cname} (structured; see JSON snapshot)")
+    collectors = snapshot.get("collectors", {})
+    if collectors:
+        from esac_tpu.obs.timeline import flatten_numeric
+
+        lines.append("# TYPE esac_collector_value untyped")
+    for cname in sorted(collectors):
+        flat = flatten_numeric(collectors[cname]) \
+            if isinstance(collectors[cname], dict) else {}
+        lines.append(
+            f"# COLLECTOR {cname} ({len(flat)} numeric leaves; full "
+            "structure in the JSON snapshot)"
+        )
+        for path in sorted(flat):
+            labels = _prom_labels({"collector": cname, "path": path})
+            lines.append(f"esac_collector_value{labels} "
+                         f"{_prom_value(flat[path])}")
     return "\n".join(lines) + "\n"
+
+
+def render_traces(snapshot: dict, k: int = 5) -> str:
+    """Human rendering of the K slowest sampled traces carried by a
+    snapshot's ``traces`` collector (``python -m esac_tpu.obs
+    --traces``): per trace the root stage walk (the fleet telescoping
+    partition) and the child span tree with per-stage durations."""
+    block = snapshot.get("collectors", {}).get("traces")
+    if not isinstance(block, dict) or not block.get("slowest"):
+        return ("no sampled traces in this snapshot (enable "
+                "FleetPolicy.trace_sample / MicroBatchDispatcher("
+                "trace=True) and re-capture)\n")
+    out = [f"{min(k, len(block['slowest']))} slowest sampled traces "
+           f"({block.get('retained', '?')} retained, "
+           f"{block.get('added', '?')} recorded):"]
+
+    def ms(v):
+        return f"{v * 1e3:.2f}ms" if isinstance(v, (int, float)) else "?"
+
+    for t in block["slowest"][:k]:
+        out.append(
+            f"\ntrace {t.get('trace_id')}  scene={t.get('scene')} "
+            f"outcome={t.get('outcome')}  total={ms(t.get('total_s'))}  "
+            f"(1-in-{t.get('sampled_1_in', 1)} sampled, "
+            f"residual {t.get('residual_s', 0):.2e}s)"
+        )
+        for stage, dt in t.get("root_stages", []):
+            out.append(f"  |- {stage:<18} {ms(dt)}")
+        spans = t.get("spans", [])
+        by_parent: dict = {}
+        for s in spans:
+            by_parent.setdefault(s.get("parent_id"), []).append(s)
+
+        def walk(parent, depth):
+            for s in by_parent.get(parent, []):
+                ann = s.get("annotations", {})
+                ann_s = " ".join(f"{a}={ann[a]}" for a in sorted(ann))
+                dur = (ms(s.get("duration_s"))
+                       if s.get("kind") != "event" else "event")
+                out.append(f"  {'   ' * depth}+- [{s.get('kind')}] "
+                           f"{s.get('name')}  {dur}  {ann_s}".rstrip())
+                for stage, dt in s.get("stages", []) or []:
+                    out.append(f"  {'   ' * (depth + 1)}.  "
+                               f"{stage:<16} {ms(dt)}")
+                walk(s.get("span_id"), depth + 1)
+
+        walk(None, 0)
+    return "\n".join(out) + "\n"
 
 
 def provenance(fleet_snapshot: dict | None = None) -> dict:

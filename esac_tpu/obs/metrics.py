@@ -87,7 +87,8 @@ class StreamingHistogram:
     """
 
     __slots__ = ("_lo", "_log_lo", "_log_growth", "_n_buckets", "_lock",
-                 "_epochs", "_epoch_cap", "_counts", "_stats")
+                 "_epochs", "_epoch_cap", "_counts", "_stats",
+                 "_life_counts", "_life_n", "_life_sum")
 
     def __init__(self, lo: float = _HIST_LO, hi: float = _HIST_HI,
                  growth: float = _HIST_GROWTH,
@@ -111,6 +112,14 @@ class StreamingHistogram:
         # stats = [count, sum, min, max].
         self._counts: list[list[int]] = [self._new_counts()]
         self._stats: list[list[float]] = [[0, 0.0, math.inf, -math.inf]]
+        # LIFETIME (never-rotated) bucket counts: the timeline layer
+        # (obs/timeline.py) diffs these between ticks to build exact
+        # per-window histograms — windowed epoch counts rotate, so their
+        # diffs can go negative and cannot anchor a delta.  One extra
+        # fixed-size array + two scalars: the fixed-memory bound holds.
+        self._life_counts: list[int] = self._new_counts()
+        self._life_n = 0
+        self._life_sum = 0.0
 
     def _new_counts(self) -> list[int]:
         return [0] * (self._n_buckets + 2)  # + underflow/overflow slots
@@ -125,12 +134,16 @@ class StreamingHistogram:
         v = float(v)
         with self._lock:
             counts, stats = self._counts[-1], self._stats[-1]
-            counts[self._index(v)] += 1
+            i = self._index(v)
+            counts[i] += 1
+            self._life_counts[i] += 1
+            self._life_n += 1
             stats[0] += 1
             if math.isfinite(v):
                 stats[1] += v
                 stats[2] = min(stats[2], v)
                 stats[3] = max(stats[3], v)
+                self._life_sum += v
             if self._epoch_cap is not None and stats[0] >= self._epoch_cap:
                 self._counts.append(self._new_counts())
                 self._stats.append([0, 0.0, math.inf, -math.inf])
@@ -185,9 +198,30 @@ class StreamingHistogram:
                                    self._log_lo, self._log_growth)
 
     def reset(self) -> None:
+        """Clear the WINDOW.  The lifetime stream (:meth:`lifetime`) is
+        deliberately untouched: it is a monotone accounting stream like
+        a counter, so timeline deltas survive a stats reset instead of
+        going negative."""
         with self._lock:
             self._counts = [self._new_counts()]
             self._stats = [[0, 0.0, math.inf, -math.inf]]
+
+    def lifetime(self):
+        """(bucket counts copy, n, sum) over the histogram's LIFETIME —
+        the monotone stream the timeline layer diffs per window."""
+        with self._lock:
+            return list(self._life_counts), self._life_n, self._life_sum
+
+    def quantile_from_counts(self, counts, n, q: float) -> float:
+        """Nearest-rank quantile over caller-supplied bucket counts in
+        THIS histogram's bucket geometry (the timeline's per-window
+        delta histograms) — bucket midpoints; a rank landing in the
+        underflow bucket reports the bucket floor ``lo`` (per-window
+        extrema are not retained, and +inf here would leak
+        non-JSON-standard tokens into window records — review
+        finding)."""
+        return self._quantile_from(counts, n, self._lo, math.inf, q,
+                                   self._log_lo, self._log_growth)
 
     def summary(self, quantiles=(0.5, 0.9, 0.99)) -> dict:
         counts, n, s, lo, hi = self.merged()
@@ -328,6 +362,12 @@ class HistogramVec:
         with self._lock:
             return [dict(k) for k in self._children]
 
+    def children(self) -> list[tuple[dict, "StreamingHistogram"]]:
+        """(labels, child) snapshot — the timeline layer's iteration
+        surface (children are internally locked; the list is a copy)."""
+        with self._lock:
+            return [(dict(k), h) for k, h in self._children.items()]
+
     def _select(self, sub: dict) -> list[StreamingHistogram]:
         with self._lock:
             return [h for k, h in self._children.items() if _matches(k, sub)]
@@ -412,6 +452,14 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._metrics: dict[str, object] = {}
         self._collectors: dict[str, object] = {}
+        # ISSUE 15 attachments (created on demand, idempotent): the
+        # ring-bounded trace store, the windowed-aggregate timeline and
+        # the health-rule engine.  Each owns a LEAF lock of the
+        # committed lock graph; the registry lock only guards the
+        # attachment slots themselves.
+        self._trace_store = None
+        self._timeline = None
+        self._health_rules = None
 
     def _instrument(self, name: str, factory, kind: str):
         with self._lock:
@@ -459,6 +507,84 @@ class MetricsRegistry:
                     f"metric {instrument.name!r} already registered with a "
                     "different instrument object"
                 )
+
+    # ---- ISSUE 15 attachments ----
+
+    def trace_store(self, maxlen: int = 256) -> "TraceStore":
+        """The registry's ring-bounded :class:`~esac_tpu.obs.trace.\
+TraceStore`, created on first call (idempotent; ``maxlen`` binds only
+        at creation) and published as the ``traces`` collector.  Every
+        tracing surface (dispatcher, FleetRouter) that mints traces
+        calls this once at construction."""
+        from esac_tpu.obs.trace import TraceStore
+
+        with self._lock:
+            ts = self._trace_store
+            if ts is None:
+                ts = self._trace_store = TraceStore(maxlen)
+        self.register_collector("traces", ts.snapshot)
+        return ts
+
+    def get_trace_store(self) -> "TraceStore | None":
+        """The attached trace store, or None (never creates)."""
+        with self._lock:
+            return self._trace_store
+
+    def tables(self) -> tuple[dict, dict]:
+        """Locked copy of (instruments, collectors) — the iteration
+        surface ``snapshot()`` and the timeline's aggregation share
+        (the registry lock is released before any instrument lock is
+        taken; the committed lock order stays acyclic)."""
+        with self._lock:
+            return dict(self._metrics), dict(self._collectors)
+
+    def attach_timeline(self, window_s: float = 1.0,
+                        max_windows: int = 120,
+                        collectors: bool = True):
+        """Attach (or return the existing) :class:`~esac_tpu.obs.\
+timeline.Timeline` over this registry, published as the ``timeline``
+        collector.  Idempotent: sizing binds at first attach."""
+        from esac_tpu.obs.timeline import Timeline
+
+        with self._lock:
+            tl = self._timeline
+            if tl is None:
+                tl = self._timeline = Timeline(
+                    self, window_s=window_s, max_windows=max_windows,
+                    collectors=collectors,
+                )
+        self.register_collector("timeline", tl.snapshot)
+        return tl
+
+    def timeline(self):
+        """The attached timeline, or None (never creates)."""
+        with self._lock:
+            return self._timeline
+
+    def attach_health_rules(self, rules=None, max_alerts: int = 256,
+                            **timeline_kw):
+        """Attach (or return the existing) :class:`~esac_tpu.obs.rules.\
+RuleEngine` over this registry's timeline (attached too when missing),
+        published as the ``health_alerts`` collector plus the
+        ``health_alerts_total`` counter / ``health_alert_active`` gauge.
+        ``rules=None`` takes the default catalog (DESIGN.md §19)."""
+        from esac_tpu.obs.rules import RuleEngine, default_rules
+
+        tl = self.attach_timeline(**timeline_kw)
+        with self._lock:
+            eng = self._health_rules
+            if eng is None:
+                eng = self._health_rules = RuleEngine(
+                    tl, default_rules() if rules is None else rules,
+                    registry=None, max_alerts=max_alerts,
+                )
+        eng.bind_obs(self)
+        return eng
+
+    def health_rules(self):
+        """The attached rule engine, or None (never creates)."""
+        with self._lock:
+            return self._health_rules
 
     def register_collector(self, name: str, fn) -> None:
         """Attach a named pull collector: a zero-argument callable

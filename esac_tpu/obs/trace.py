@@ -1,4 +1,4 @@
-"""Request-scoped span chains (DESIGN.md §14): where a request's time went.
+"""Request-scoped span chains + fleet-wide causal traces (DESIGN.md §14/§19).
 
 A traced request carries ONE :class:`SpanChain`: an append-only list of
 (stage, monotonic-timestamp) stamps written at the dispatcher's existing
@@ -39,11 +39,63 @@ at the FIRST terminal stamp, so late post-terminal writes are inert and
 ``fsum(durations) == total == t_done - t_submit`` holds for every
 resolved request, abandoned or not (regression-pinned in
 tests/test_obs.py).
+
+Causal traces (ISSUE 15, DESIGN.md §19): a :class:`SpanChain` sees ONE
+dispatcher.  A request today crosses the FleetRouter (affinity / spill /
+failover, §18), a replica dispatcher, and — on a cache fault — the host
+tier, prefetcher and disk (§17).  :class:`Trace` is the container that
+ties those tiers together under one trace id:
+
+- the ROOT of a trace is a SpanChain in the minting tier's clock domain
+  (the FleetRouter's for fleet traces: submitted -> routing ->
+  replica [-> failover_routing -> replica ...] -> outcome; the
+  dispatcher's own admitted -> ... -> outcome chain for traces minted by
+  a standalone traced dispatcher).  The root chain IS the telescoping
+  contract at fleet scope: router overhead + replica span(s) (+ failover
+  siblings) partition [t_submit, t_done] exactly, because every segment
+  is a consecutive-stamp diff in ONE clock — fsum(durations) == total,
+  the §14 invariant lifted a tier;
+- child :class:`Span` records nest under it — the replica dispatch (the
+  underlying request's admitted->...->outcome stage chain, measured in
+  the DISPATCHER's clock and telescoping on its own), the registry fault
+  path (cache miss -> host-tier hit or disk load -> decompress -> stage,
+  with prefetch-coalesced demand faults annotated), and
+  breaker/quarantine events as zero-duration event spans.  A failover
+  re-dispatch span carries ``retry_of`` linking it to the sibling it
+  replaced;
+- writes are LOCKLESS: ``spans`` is an append-only list (GIL-atomic
+  appends, same contract as SpanChain stamps — the writer at any instant
+  is the single thread owning that phase of the request, and the one
+  documented exception, a late span from an abandoned dispatch's wedged
+  worker, appends after ``finish()`` and stays out of any snapshot that
+  already rendered).  The read side copies.
+
+Trace CONTEXT flows to the registry tiers through a contextvar, not an
+argument: the dispatcher wraps each dispatch attempt in
+:func:`trace_scope` with the batch's traced requests' traces, and the
+weight cache / host tier / scene-health machinery record spans into
+:func:`active_traces` when (and only when) the running dispatch carries
+one — zero plumbing through jitted-adjacent signatures, zero cost when
+no trace is active (one contextvar read on the fault path, which is
+already a multi-ms path).  :func:`issuer_scope` marks the prefetcher's
+thread so a demand fault coalescing onto an in-flight prefetch is
+annotated as exactly that.
+
+:class:`TraceStore` is the ring-bounded home of completed traces (the
+``traces`` obs collector; ``python -m esac_tpu.obs --traces`` renders
+the K slowest).  Its lock is a LEAF of the committed lock graph:
+``add`` is a deque append, nothing is ever acquired under it.
 """
 
 from __future__ import annotations
 
+import collections
+import contextlib
+import contextvars
+import itertools
 import math
+import os
+import threading
 
 # The non-terminal stages, in dispatch order.
 STAGES = ("admitted", "coalesced", "staged", "dispatched", "device",
@@ -101,3 +153,233 @@ class SpanChain:
         """|fsum(durations) - total| — 0 up to float summation noise;
         exported by the bench so the artifact carries the evidence."""
         return abs(math.fsum(self.durations().values()) - self.total())
+
+
+# ---------------------------------------------------------------------------
+# Causal traces (ISSUE 15): trace ids, child spans, context propagation.
+# ---------------------------------------------------------------------------
+
+_TRACE_SEQ = itertools.count(1)  # .__next__ is GIL-atomic
+
+
+def new_trace_id() -> str:
+    """Process-unique, cheap trace id (no uuid import on the hot path)."""
+    return f"t{os.getpid():x}-{next(_TRACE_SEQ):x}"
+
+
+class Span:
+    """One child record of a :class:`Trace`: a named [t0, t1] interval
+    (``kind`` in dispatch / weight_fault / event) with optional per-stage
+    segments (a dispatch span carries the underlying request's chain
+    segments) and free-form annotations.  Immutable after construction
+    except ``parent_id``, which :meth:`Trace.finish` may assign by
+    interval containment (a weight-fault span recorded mid-dispatch is
+    adopted by the dispatch span that covers it)."""
+
+    __slots__ = ("span_id", "parent_id", "name", "kind", "t0", "t1",
+                 "stages", "annotations")
+
+    def __init__(self, span_id, name, kind, t0, t1, stages=None,
+                 parent_id=None, annotations=None):
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.kind = kind
+        self.t0 = t0
+        self.t1 = t1
+        self.stages = stages  # [(stage, dt)] or None
+        self.annotations = annotations or {}
+
+    def to_dict(self) -> dict:
+        out = {
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "kind": self.kind,
+            "t0": self.t0,
+            "duration_s": (self.t1 - self.t0
+                           if self.t1 is not None else None),
+        }
+        if self.stages:
+            out["stages"] = [[s, dt] for s, dt in self.stages]
+        if self.annotations:
+            out["annotations"] = dict(self.annotations)
+        return out
+
+
+class Trace:
+    """One sampled request's causal trace: a root :class:`SpanChain` in
+    the minting tier's clock plus lockless child spans (module
+    docstring).  ``root`` is stamped by the tier that minted the trace —
+    a standalone traced dispatcher hands the root chain to the request
+    itself (``req.spans is trace.root``), a FleetRouter keeps the root
+    and gives each underlying request a fresh child chain."""
+
+    __slots__ = ("trace_id", "scene", "root", "spans", "outcome", "done",
+                 "sampled_1_in", "_span_seq")
+
+    def __init__(self, t_submit: float, scene=None, trace_id: str = None,
+                 sampled_1_in: int = 1, root_stage: str = "submitted"):
+        self.trace_id = trace_id if trace_id is not None else new_trace_id()
+        self.scene = scene
+        self.root = SpanChain(root_stage, t_submit)
+        self.spans: list[Span] = []  # append-only; GIL-atomic appends
+        self.outcome = None
+        self.done = False
+        self.sampled_1_in = sampled_1_in
+        self._span_seq = itertools.count(1)
+
+    # -- write side (lockless; see module docstring) --
+
+    def stamp(self, stage: str, t: float) -> None:
+        """Stamp the ROOT chain (router overhead boundaries).  Inert
+        after the terminal stamp — the SpanChain read-side truncation."""
+        self.root.stamp(stage, t)
+
+    def add_span(self, name: str, kind: str, t0: float, t1: float,
+                 stages=None, parent_id=None, **annotations) -> Span:
+        sp = Span(next(self._span_seq), name, kind, t0, t1, stages,
+                  parent_id, annotations)
+        self.spans.append(sp)
+        return sp
+
+    def add_event(self, name: str, t: float, **annotations) -> Span:
+        """Zero-duration event span (breaker trips, quarantines,
+        prefetch coalescing)."""
+        return self.add_span(name, "event", t, t, **annotations)
+
+    def finish(self, outcome: str, t_done: float) -> bool:
+        """Terminal root stamp + adopt orphan spans into the dispatch
+        span whose interval contains them.  Idempotent (first caller
+        wins), mirroring the dispatcher's exactly-once ``_finish``."""
+        if self.done:
+            return False
+        self.stamp(outcome, t_done)
+        self.outcome = outcome
+        dispatches = [s for s in list(self.spans) if s.kind == "dispatch"]
+        for sp in list(self.spans):
+            if sp.parent_id is None and sp.kind != "dispatch":
+                for d in dispatches:
+                    if d.t0 is not None and sp.t0 is not None \
+                            and d.t0 <= sp.t0 and (d.t1 is None
+                                                   or sp.t0 <= d.t1):
+                        sp.parent_id = d.span_id
+                        break
+        self.done = True
+        return True
+
+    # -- read side --
+
+    def total(self) -> float:
+        return self.root.total()
+
+    def durations(self) -> dict[str, float]:
+        return self.root.durations()
+
+    def residual(self) -> float:
+        """The FLEET telescoping check: |fsum(root durations) - total|.
+        Router overhead + replica span(s) + failover siblings partition
+        the end-to-end span exactly (``python bench.py obs`` fleet leg
+        pins this at < 1e-6 s)."""
+        return self.root.residual()
+
+    def to_dict(self) -> dict:
+        eff = self.root._effective()
+        return {
+            "trace_id": self.trace_id,
+            "scene": self.scene,
+            "outcome": self.outcome,
+            "sampled_1_in": self.sampled_1_in,
+            "t_submit": eff[0][1],
+            "total_s": self.total(),
+            "root_stages": [[stage, dt] for stage, dt
+                            in self.root.segments()],
+            "residual_s": self.residual(),
+            "spans": [s.to_dict() for s in list(self.spans)],
+        }
+
+
+class TraceStore:
+    """Ring-bounded home of completed traces — the ``traces`` obs
+    collector.  The lock is a LEAF of the committed lock graph
+    (``add``/readers only touch the deque and counters; nothing is
+    acquired under it), so publishing a trace from inside a dispatcher
+    or router critical section is a sanctioned owner -> leaf nesting,
+    exactly like the obs instrument locks."""
+
+    def __init__(self, maxlen: int = 256):
+        if maxlen < 1:
+            raise ValueError(f"maxlen {maxlen} < 1")
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=maxlen)
+        self.added = 0
+
+    def add(self, trace: Trace) -> None:
+        with self._lock:
+            self._ring.append(trace)
+            self.added += 1
+
+    def traces(self) -> list[Trace]:
+        with self._lock:
+            return list(self._ring)
+
+    def slowest(self, k: int = 5) -> list[dict]:
+        """The K slowest COMPLETED retained traces, slowest first —
+        rendered (to_dict) outside the lock."""
+        done = [t for t in self.traces() if t.done]
+        done.sort(key=lambda t: t.total(), reverse=True)
+        return [t.to_dict() for t in done[:k]]
+
+    def snapshot(self) -> dict:
+        """The ``traces`` collector payload: counts + the 5 slowest."""
+        with self._lock:
+            retained = len(self._ring)
+            added = self.added
+        return {
+            "added": added,
+            "retained": retained,
+            "slowest": self.slowest(5),
+        }
+
+
+# -- context propagation (dispatcher -> registry tiers) --
+
+_ACTIVE_TRACES: contextvars.ContextVar = contextvars.ContextVar(
+    "esac_obs_active_traces", default=()
+)
+_ISSUER: contextvars.ContextVar = contextvars.ContextVar(
+    "esac_obs_issuer", default="demand"
+)
+
+
+def active_traces() -> tuple:
+    """The traces carried by the dispatch currently running in this
+    thread (empty when untraced — the common case, one contextvar
+    read)."""
+    return _ACTIVE_TRACES.get()
+
+
+@contextlib.contextmanager
+def trace_scope(traces):
+    """Run a dispatch attempt with ``traces`` visible to the registry
+    fault path (weight cache, host tier, scene health)."""
+    token = _ACTIVE_TRACES.set(tuple(traces))
+    try:
+        yield
+    finally:
+        _ACTIVE_TRACES.reset(token)
+
+
+def current_issuer() -> str:
+    """Who is driving this thread's cache/tier loads: "demand" (a
+    dispatch) or "prefetch" (the predictive prefetcher's cycle)."""
+    return _ISSUER.get()
+
+
+@contextlib.contextmanager
+def issuer_scope(name: str):
+    token = _ISSUER.set(name)
+    try:
+        yield
+    finally:
+        _ISSUER.reset(token)

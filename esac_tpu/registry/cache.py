@@ -50,8 +50,11 @@ from __future__ import annotations
 
 import collections
 import threading
+import time
 from collections.abc import Callable
 from typing import Any
+
+from esac_tpu.obs.trace import active_traces, current_issuer
 
 
 def tree_nbytes(tree: Any) -> int:
@@ -131,7 +134,16 @@ class DeviceWeightCache:
     def get(self, entry) -> Any:
         """Device param tree for ``entry`` (anything with a ``.key``); loads
         and stages on miss — outside the lock, under a per-key future —
-        evicting LRU entries until the budget holds."""
+        evicting LRU entries until the budget holds.
+
+        Causal tracing (ISSUE 15): when the running dispatch carries
+        sampled traces (``obs.trace.active_traces``), the fault path
+        records ONE weight_fault span per trace — miss -> host-tier hit
+        or disk load -> decompress -> stage as stage segments, or the
+        coalesced wait on another issuer's in-flight load (a demand
+        fault riding a prefetch is annotated ``coalesced_with=
+        "prefetch"``).  Warm hits record nothing; the untraced fault
+        path pays one contextvar read."""
         import jax
 
         key = entry.key
@@ -144,26 +156,55 @@ class DeviceWeightCache:
             if fut is None:
                 fut = self._loading[key] = {
                     "event": threading.Event(), "result": None, "error": None,
+                    "issuer": current_issuer(),
                 }
                 owner = True
             else:
                 owner = False
             self.misses += 1
             gen = self._gen
+        traces = active_traces()
         if not owner:
             # Another worker owns this key's load: wait for its future.
             # The tree is handed over directly (not re-looked-up), so a
             # racing eviction cannot turn a completed load into a miss.
+            t0 = time.perf_counter() if traces else None
             fut["event"].wait()
+            for tr in traces:
+                tr.add_span(
+                    f"weight_fault:{key}", "weight_fault",
+                    t0, time.perf_counter(), key=str(key),
+                    coalesced=True,
+                    coalesced_with=fut.get("issuer", "demand"),
+                    failed=fut["error"] is not None,
+                )
             if fut["error"] is not None:
                 raise fut["error"]
             return fut["result"]
         try:
-            host, payload, from_tier = self._read_host(entry)
+            t0 = time.perf_counter() if traces else None
+            host, payload, from_tier, t_payload = self._read_host(entry)
             tree = (
                 jax.device_put(host, self._device)
                 if self._device is not None else jax.device_put(host)
             )
+            if traces:
+                t_staged = time.perf_counter()
+                # t_payload marks payload-in-hand (host-tier hit, or
+                # disk read + compress); what follows it is the
+                # decompress + device_put issue.
+                stages = [
+                    ("read_host" if from_tier else "read_disk",
+                     t_payload - t0),
+                    ("decompress_stage", t_staged - t_payload),
+                ]
+                for tr in traces:
+                    tr.add_span(
+                        f"weight_fault:{key}", "weight_fault", t0,
+                        t_staged, stages=list(stages), key=str(key),
+                        source="host_tier" if from_tier else "disk",
+                        issuer=current_issuer(), coalesced=False,
+                    )
             with self._lock:
                 # Two reasons NOT to cache a completed load: clear()
                 # bumped the generation, or evict() PURGED this key while
@@ -201,6 +242,12 @@ class DeviceWeightCache:
                 self._nbytes.pop(key, None)
                 self._payloads.pop(key, None)
             fut["event"].set()
+            for tr in traces:
+                tr.add_span(
+                    f"weight_fault:{key}", "weight_fault", t0,
+                    time.perf_counter(), key=str(key), failed=True,
+                    error=type(e).__name__, issuer=current_issuer(),
+                )
             raise
         fut["event"].set()
         self._demote(demoted)
@@ -208,22 +255,26 @@ class DeviceWeightCache:
 
     def _read_host(self, entry):
         """The owner's host-side read (NO cache lock held): returns
-        ``(host tree, tier payload or None, from_tier)``.  With a tier,
-        the host tier is consulted first (a hit skips disk AND the
-        checksum re-read), a miss pays the loader through the tier's
-        per-key future (so a prefetch racing this demand fault coalesces
-        onto one disk read), and the staged tree is ALWAYS the
-        decompressed payload — the device bytes are identical whichever
-        tier the scene arrived from."""
+        ``(host tree, tier payload or None, from_tier, t_payload)``
+        where ``t_payload`` stamps payload-in-hand (the trace span's
+        read/decompress boundary).  With a tier, the host tier is
+        consulted first (a hit skips disk AND the checksum re-read), a
+        miss pays the loader through the tier's per-key future (so a
+        prefetch racing this demand fault coalesces onto one disk
+        read), and the staged tree is ALWAYS the decompressed payload —
+        the device bytes are identical whichever tier the scene arrived
+        from."""
         from esac_tpu.registry import hosttier
 
         if self.tier is None:
-            return self._loader(entry), None, False
+            host = self._loader(entry)
+            return host, None, False, time.perf_counter()
         hit = entry.key in self.tier
         payload = self.tier.get_or_load(
             entry.key, lambda: self.tier.compress(self._loader(entry))
         )
-        return hosttier.decompress_tree(payload), payload, hit
+        t_payload = time.perf_counter()
+        return hosttier.decompress_tree(payload), payload, hit, t_payload
 
     def preload_host(self, entry) -> bool:
         """Stage ``entry`` into the HOST tier only (disk -> compressed

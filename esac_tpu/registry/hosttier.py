@@ -43,9 +43,12 @@ from __future__ import annotations
 
 import collections
 import threading
+import time
 from typing import Any
 
 import numpy as np
+
+from esac_tpu.obs.trace import active_traces, current_issuer
 
 # Top-level subtrees of a load_scene_params tree that hold CNN weights —
 # the only leaves a lossy codec may touch.
@@ -242,6 +245,7 @@ class HostWeightTier:
             if fut is None:
                 fut = self._loading[key] = {
                     "event": threading.Event(), "result": None, "error": None,
+                    "issuer": current_issuer(),
                 }
                 owner = True
             else:
@@ -249,6 +253,16 @@ class HostWeightTier:
             self.misses += 1
             gen = self._gen
         if not owner:
+            # Coalesced onto another issuer's in-flight disk read: when
+            # the running dispatch is traced and that issuer is the
+            # prefetcher, the coalescing is annotated on the trace —
+            # the "prefetch predicted this demand fault" event (ISSUE
+            # 15; the span timing itself rides the cache-level record).
+            traces = active_traces()
+            if traces and fut.get("issuer") == "prefetch":
+                t = time.perf_counter()
+                for tr in traces:
+                    tr.add_event("prefetch_coalesced", t, key=str(key))
             fut["event"].wait()
             if fut["error"] is not None:
                 raise fut["error"]

@@ -60,6 +60,8 @@ import math
 import threading
 import time
 
+from esac_tpu.obs.trace import issuer_scope
+
 
 @dataclasses.dataclass(frozen=True)
 class PrefetchPolicy:
@@ -275,33 +277,38 @@ class WeightPrefetcher:
         # The scan itself is bounded, not just the issues: every scene
         # examined costs prefetch_targets (health + manifest locks).
         host_targets = ranked[:min(host_n, pol.host_scan_limit)]
-        for scene in device_targets:
-            if len(issued["device"]) >= pol.max_device_per_cycle:
-                break
-            for entry in self._registry.prefetch_targets(scene):
+        # Issuer mark (ISSUE 15): every per-key load future this cycle
+        # creates records the prefetcher as its issuer, so a traced
+        # demand fault coalescing onto it is annotated
+        # "prefetch-coalesced" instead of reading as a plain disk wait.
+        with issuer_scope("prefetch"):
+            for scene in device_targets:
                 if len(issued["device"]) >= pol.max_device_per_cycle:
                     break
-                if entry.key in cache or entry.key in cooled:
-                    continue
-                try:
-                    cache.get(entry)  # rides the per-key load future
-                    issued["device"].append(entry.key)
-                except Exception:  # noqa: BLE001 — a mispredicted/faulted load is counted, never fatal
-                    failures += 1
-        if tier is not None:
-            for scene in host_targets:
-                if len(issued["host"]) >= pol.max_host_per_cycle:
-                    break
                 for entry in self._registry.prefetch_targets(scene):
-                    if len(issued["host"]) >= pol.max_host_per_cycle:
+                    if len(issued["device"]) >= pol.max_device_per_cycle:
                         break
-                    if entry.key in tier or entry.key in cache:
+                    if entry.key in cache or entry.key in cooled:
                         continue
                     try:
-                        cache.preload_host(entry)
-                        issued["host"].append(entry.key)
-                    except Exception:  # noqa: BLE001
+                        cache.get(entry)  # rides the per-key load future
+                        issued["device"].append(entry.key)
+                    except Exception:  # noqa: BLE001 — a mispredicted/faulted load is counted, never fatal
                         failures += 1
+            if tier is not None:
+                for scene in host_targets:
+                    if len(issued["host"]) >= pol.max_host_per_cycle:
+                        break
+                    for entry in self._registry.prefetch_targets(scene):
+                        if len(issued["host"]) >= pol.max_host_per_cycle:
+                            break
+                        if entry.key in tier or entry.key in cache:
+                            continue
+                        try:
+                            cache.preload_host(entry)
+                            issued["host"].append(entry.key)
+                        except Exception:  # noqa: BLE001
+                            failures += 1
         # Wasted: credited keys that left BOTH tiers before any arrival
         # claimed them — the misprediction record.
         wasted = [

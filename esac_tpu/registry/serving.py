@@ -937,14 +937,25 @@ class SceneRegistry:
                     self.cache.evict((scene, version))
 
     def _record_event(self, kind: str, **fields) -> None:
+        t = self._clock()
         with self._health_lock:
             # Counter and event log move in the same critical section —
             # a monitor snapshot must never see the counter ahead of the
             # events list (the dispatcher's _count_* convention).
             self._m_health_events.inc(event=kind)
             self.health_events.append({
-                "t": self._clock(), "event": kind, **fields,
+                "t": t, "event": kind, **fields,
             })
+        # Causal tracing (ISSUE 15): breaker/canary actions judged
+        # DURING a traced dispatch (deferred probes run between
+        # dispatches, in the worker thread) nest as event spans under
+        # that dispatch's traces.  Outside the lock — lockless appends,
+        # and the common untraced path pays one contextvar read.
+        from esac_tpu.obs.trace import active_traces
+
+        for tr in active_traces():
+            tr.add_event(f"scene_health:{kind}", time.perf_counter(),
+                         **{k: str(v) for k, v in fields.items()})
 
     def _health_collector(self) -> dict:
         """The obs pull collector behind ``scene_health``: the same

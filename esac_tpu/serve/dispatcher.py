@@ -95,7 +95,7 @@ import collections
 import threading
 import time
 
-from esac_tpu.obs import MetricsRegistry, SpanChain
+from esac_tpu.obs import MetricsRegistry, SpanChain, Trace, trace_scope
 from esac_tpu.ransac.config import RansacConfig
 from esac_tpu.serve.batching import (
     pad_batch,
@@ -122,7 +122,7 @@ class _Request:
 
     __slots__ = ("frame", "scene", "route_k", "event", "result", "error",
                  "t_submit", "t_done", "deadline", "done", "outcome",
-                 "owner", "spans")
+                 "owner", "spans", "trace")
 
     def __init__(self, frame, t_submit, scene=None, route_k=None,
                  deadline=None, owner=None):
@@ -139,6 +139,8 @@ class _Request:
         self.outcome = None       # served|shed|expired|degraded|failed
         self.owner = owner        # dispatcher, for timeout abandonment
         self.spans = None         # obs.SpanChain when tracing is on
+        self.trace = None         # obs.Trace: dispatcher-minted, or the
+        #                           fleet trace riding in via trace_ctx
 
     def get(self, timeout: float | None = None):
         """Wait up to ``timeout`` seconds for the result; raises the
@@ -289,6 +291,16 @@ class MicroBatchDispatcher:
         # per-request span chains; everything else is always on.
         self.obs = obs if obs is not None else MetricsRegistry()
         self._trace = bool(trace)
+        # Completed dispatcher-MINTED traces land here (the ``traces``
+        # collector; python -m esac_tpu.obs --traces).  Fleet traces
+        # riding in via submit(trace_ctx=...) belong to the router's
+        # store — this dispatcher only stamps their child chains.
+        self._trace_store = self.obs.trace_store() if self._trace else None
+        # Fast-path gate for _stamp: stays False until either this
+        # dispatcher traces everything or a trace-carrying request has
+        # been seen, so the tracing-off request path keeps its exact
+        # pre-ISSUE-15 instruction count.
+        self._tracing_any = self._trace
         self._m_offered = self.obs.counter(
             "serve_offered_total",
             "requests ever offered (re-based by reset_stats)",
@@ -362,7 +374,8 @@ class MicroBatchDispatcher:
     # ---------------- request path ----------------
 
     def submit(self, frame: dict, scene=None, route_k=None,
-               deadline_ms: float | None = None) -> _Request:
+               deadline_ms: float | None = None,
+               trace_ctx: Trace | None = None) -> _Request:
         """Enqueue one frame tree (optionally for a registry ``scene`` and
         a routed top-K program ``route_k``); returns a request whose
         ``event`` fires when ``result`` (or ``error``) is set.
@@ -373,7 +386,13 @@ class MicroBatchDispatcher:
         a predicted deadline miss raises a typed
         :class:`~esac_tpu.serve.slo.ShedError` subclass immediately, and
         the request carries ``deadline_ms`` (default
-        ``slo.deadline_ms``)."""
+        ``slo.deadline_ms``).
+
+        ``trace_ctx`` is a fleet :class:`~esac_tpu.obs.Trace` minted one
+        tier up (FleetRouter sampling, ISSUE 15): the request gets a
+        span chain and rides the registry fault path traced regardless
+        of this dispatcher's own ``trace`` flag — the dispatcher stamps
+        the CHILD chain, the router owns the root and the store."""
         t_submit = self._clock()
         if self._arrival_sink is not None and scene is not None:
             # Arrival tap for the prefetcher: outside the lock, before
@@ -388,8 +407,7 @@ class MicroBatchDispatcher:
         deadline = (t_submit + deadline_ms / 1e3
                     if deadline_ms is not None else None)
         req = _Request(frame, t_submit, scene, route_k, deadline, owner=self)
-        if self._trace:
-            req.spans = SpanChain("admitted", t_submit)
+        self._init_trace(req, trace_ctx, t_submit, scene)
         lane = (scene, route_k)
         with self._work:
             if self._slo is None:
@@ -421,6 +439,22 @@ class MicroBatchDispatcher:
             self._n_pending += 1
             self._work.notify()
         return req
+
+    def _init_trace(self, req: _Request, trace_ctx, t_submit, scene):
+        """Arm tracing for one request: a fleet ``trace_ctx`` gets a
+        fresh CHILD chain (the router owns the root); a standalone
+        traced dispatcher mints its own :class:`~esac_tpu.obs.Trace`
+        whose ROOT chain is the request's chain (``req.spans is
+        req.trace.root`` marks dispatcher ownership — that is what
+        _finish keys store publication on)."""
+        if trace_ctx is not None:
+            req.trace = trace_ctx
+            req.spans = SpanChain("admitted", t_submit)
+            if not self._tracing_any:
+                self._tracing_any = True
+        elif self._trace:
+            req.trace = Trace(t_submit, scene=scene, root_stage="admitted")
+            req.spans = req.trace.root
 
     def _raise_if_unservable(self):
         """Reject submissions to a server that can no longer serve them
@@ -503,8 +537,7 @@ class MicroBatchDispatcher:
             bounds += [t_submit + timeout] if timeout is not None else []
             req = _Request(frame, t_submit, scene, route_k,
                            min(bounds) if bounds else None, owner=self)
-            if self._trace:
-                req.spans = SpanChain("admitted", t_submit)
+            self._init_trace(req, None, t_submit, scene)
             with self._work:
                 self._raise_if_unservable()
                 self._count_offered()
@@ -634,8 +667,11 @@ class MicroBatchDispatcher:
         timeout / watchdog while this dispatch was in flight) are
         skipped best-effort; the unavoidable race remnant — a late stamp
         landing after the terminal one — is made inert by the chain's
-        read-side truncation (obs.trace)."""
-        if not self._trace:
+        read-side truncation (obs.trace).  The gate covers fleet
+        trace_ctx requests too (``_tracing_any`` flips on the first
+        one); per-request ``spans`` checks below keep mixed batches
+        correct."""
+        if not self._tracing_any:
             return
         if t is None:
             t = self._clock()
@@ -677,6 +713,16 @@ class MicroBatchDispatcher:
             req.spans.stamp(outcome, req.t_done)
             for stage, dt in req.spans.durations().items():
                 self._m_stage.observe(dt, stage=stage)
+            if req.trace is not None and req.spans is req.trace.root:
+                # Dispatcher-minted trace: the request's chain IS the
+                # root (terminally stamped above, so the trace only
+                # needs its outcome/done marks — parent None == root)
+                # and this dispatcher's ring-bounded store is its home.
+                # Fleet traces (trace_ctx) are finished by the router.
+                req.trace.outcome = outcome
+                req.trace.done = True
+                if self._trace_store is not None:
+                    self._trace_store.add(req.trace)
         req.event.set()
         return True
 
@@ -840,6 +886,13 @@ class MicroBatchDispatcher:
         the watchdog discards its late outcome entirely."""
         scene, route_k = lane
         self._stamp(reqs, "coalesced")
+        # Trace context for the registry fault path (ISSUE 15): the
+        # batch's traces ride a contextvar through the dispatch so the
+        # weight cache / host tier / health machinery can record spans
+        # without signature plumbing.  Zero-cost with tracing off (the
+        # _tracing_any gate skips even the comprehension).
+        traced = ([r.trace for r in reqs if r.trace is not None]
+                  if self._tracing_any else [])
         attempt = 0
         while True:
             with self._work:
@@ -848,8 +901,13 @@ class MicroBatchDispatcher:
                 infl = _Inflight(gen, lane, reqs, self._clock())
                 self._inflight = infl
             try:
-                host, bucket, n_valid, t_done = self._dispatch(reqs, scene,
-                                                               eff_k)
+                if traced:
+                    with trace_scope(traced):
+                        host, bucket, n_valid, t_done = self._dispatch(
+                            reqs, scene, eff_k)
+                else:
+                    host, bucket, n_valid, t_done = self._dispatch(
+                        reqs, scene, eff_k)
                 import jax
 
                 # Host-side result slicing: inside the try — a malformed

@@ -177,6 +177,16 @@ def run_open_loop(
             return float("nan")
         return float(lat[min(len(lat) - 1, round(p * (len(lat) - 1)))])
 
+    # Per-request trace ids (ISSUE 15): when the dispatcher/router
+    # samples causal traces, every admitted request's id rides the
+    # summary so an artifact's slow points can be joined back to their
+    # exemplar traces (None for unsampled/shed requests).
+    trace_ids: list = [None] * n
+    for i, req, _t in admitted:
+        tr = getattr(req, "trace", None)
+        if tr is not None:
+            trace_ids[i] = tr.trace_id
+
     out = {
         "offered": n,
         "offered_rps_target": round(n / float(arrivals[-1]), 2),
@@ -190,7 +200,15 @@ def run_open_loop(
         "span_s": round(span, 3),
         "per_request_outcomes": outcomes,
         "per_request_error_types": err_types,
+        "per_request_trace_ids": trace_ids,
     }
+    obs = getattr(disp, "obs", None)
+    store = obs.get_trace_store() if obs is not None \
+        and hasattr(obs, "get_trace_store") else None
+    if store is not None:
+        # Exemplar slow traces for the artifact (the loadtest/fleet
+        # artifacts' "where did the tail go" evidence).
+        out["exemplar_slow_traces"] = store.slowest(3)
     per_scene, per_route = _lane_latency_views(disp)
     if per_scene is not None:
         out["per_scene"] = per_scene

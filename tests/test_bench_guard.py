@@ -957,6 +957,14 @@ def test_fleet_artifact_schema_committed():
     assert drill["failed_over_requests"] >= 1
     assert drill["failover_p99_ms"] is not None
     assert drill["failover_bit_identical"] is True
+    # ISSUE 15: always-on sampled causal tracing rode the drill —
+    # every sampled trace telescopes exactly at fleet scope, and the
+    # exemplar slow traces ride the artifact.
+    tr = drill["traces"]
+    assert tr["sample_1_in"] >= 1 and tr["sampled"] > 0
+    assert tr["telescoping_exact"] is True
+    assert tr["max_abs_residual_s"] < 1e-6
+    assert tr["exemplar_slow_traces"]
     # The injected fault landed on exactly ONE replica: the target
     # stalled once, every other armed injector only counted unmatched.
     stats = drill["injector_stats"]
@@ -1014,6 +1022,39 @@ def _canned_obs():
                          "dispatched": 0.1, "device": 6.8, "sliced": 0.1,
                          "served": 0.04},
         "snapshot_json_ok": True,
+        "fleet": {
+            "replicas": 2, "n_frames": 24, "repeats": 9,
+            "tracing_off": {"wall_s_median": 0.30,
+                            "wall_s_spread": [0.29, 0.30, 0.31],
+                            "requests_per_s": 80.0},
+            "tracing_on": {"wall_s_median": 0.303,
+                           "wall_s_spread": [0.30, 0.303, 0.31],
+                           "requests_per_s": 79.2},
+            "overhead_pct": 1.0,
+            "pair_wall_ratios": [0.99, 1.01, 1.02],
+            "throughput_ratio_on_over_off": 0.9901,
+            "within_3pct": True,
+            "jit_cache_misses_added": 0,
+            "telescoping": {
+                "traces_checked": 24, "max_abs_residual_s": 0.0,
+                "sums_match_e2e": True,
+                "failover": {
+                    "checked": True, "served": True, "residual_s": 0.0,
+                    "sums_match_e2e": True,
+                    "root_stages": ["routing", "replica",
+                                    "failover_routing", "replica",
+                                    "served"],
+                    "dispatch_spans": 2, "retry_linked": True,
+                    "wedged_replica": "f0",
+                },
+            },
+            "timeline": {"ticks": 12, "windows_retained": 11,
+                         "ring_bounded": True},
+            "alerts": {"rules": ["slo_burn_rate"], "events": 0,
+                       "quiet": True},
+            "exemplar_slow_traces": [],
+            "note": "canned",
+        },
         "obs_snapshot": {
             "obs_schema": 1, "recorded_at_unix": 0.0,
             "metrics": {}, "collectors": {},
@@ -1045,6 +1086,11 @@ def test_obs_main_emits_one_json_line_and_artifact(tmp_path, monkeypatch, capsys
     assert out["jit_cache_misses_added"] == 0
     assert out["span_sums_match_e2e"] is True
     assert out["snapshot_json_ok"] is True
+    # ISSUE 15: the fleet leg's gates ride the one JSON line too.
+    assert out["fleet_within_3pct"] is True
+    assert out["fleet_jit_cache_misses_added"] == 0
+    assert out["fleet_telescoping_ok"] is True
+    assert out["fleet_overhead_pct"] == 1.0
     assert out["device_kind"] == "fake-tpu"
     assert "contention" in out
     artifact = json.loads((tmp_path / "obs.json").read_text())
@@ -1096,7 +1142,12 @@ def test_obs_artifact_schema_committed():
     """The committed .obs_overhead.json satisfies the acceptance gates:
     tracing-on throughput within 3% of off, zero added jit cache misses,
     every traced request's span durations summing to its end-to-end
-    latency, and a json-dumpable embedded fleet snapshot."""
+    latency, a json-dumpable embedded fleet snapshot — and, since ISSUE
+    15, the FLEET leg: tracing+timeline through a FleetRouter over 2
+    replicas within the same 3% pair-median gate, zero jit cache
+    misses, the fleet telescoping sum exact (router + replica spans +
+    failover siblings == e2e) including across the forced failover
+    drill, the timeline ring bounded and a quiet rule catalog."""
     import pathlib
 
     path = pathlib.Path(bench.__file__).parent / ".obs_overhead.json"
@@ -1123,6 +1174,29 @@ def test_obs_artifact_schema_committed():
     assert snap["obs_schema"] == 1
     assert "serve_stage_seconds" in snap["metrics"]
     assert artifact["obs_provenance"]["fleet"]["obs_schema"] == 1
+    # ---- ISSUE 15 fleet leg (the acceptance gate) ----
+    fleet = obs["fleet"]
+    assert fleet["replicas"] == 2
+    assert fleet["within_3pct"] is True
+    assert fleet["throughput_ratio_on_over_off"] >= 0.97
+    assert fleet["jit_cache_misses_added"] == 0
+    tele = fleet["telescoping"]
+    assert tele["traces_checked"] > 0
+    assert tele["sums_match_e2e"] is True
+    assert tele["max_abs_residual_s"] < 1e-6
+    fo = tele["failover"]
+    assert fo["checked"] is True and fo["served"] is True
+    assert fo["sums_match_e2e"] is True and fo["residual_s"] < 1e-6
+    assert fo["dispatch_spans"] == 2 and fo["retry_linked"] is True
+    assert "failover_routing" in fo["root_stages"]
+    assert fleet["timeline"]["ring_bounded"] is True
+    assert fleet["timeline"]["ticks"] > 0
+    assert fleet["alerts"]["quiet"] is True
+    # Exemplar slow traces ride the artifact, json-clean.
+    json.dumps(fleet["exemplar_slow_traces"])
+    assert fleet["exemplar_slow_traces"]
+    assert all(t["residual_s"] < 1e-6
+               for t in fleet["exemplar_slow_traces"])
 
 
 # ---------------- prefetch / weight-tier driver contract (ISSUE 13) ----

@@ -419,29 +419,39 @@ def test_r13_name_collision_still_walks_both_classes(tmp_path):
 # --------------------------------------------------------------------------
 # the repo's own fleet map
 
-def test_repo_fleet_lock_map_is_exactly_the_committed_eight_edges():
+def test_repo_fleet_lock_map_is_exactly_the_committed_ten_edges():
     """Pin the REAL fleet's lock-order graph edge-for-edge (DESIGN.md
     §15): dispatcher → {counter, histogram-vec, streaming-histogram}
     (accounting published inside the dispatch critical sections),
     registry health → counter (_record_event), health → manifest
-    (_judge_locked's rollback-target reads), and — since ISSUE 14's
-    replica-fleet tier (DESIGN.md §18) — the FleetRouter's lock over
-    the same obs-instrument leaves, mirroring the dispatcher's pattern
-    (fleet books counted inside the router's critical sections; never
-    over a dispatcher or registry lock — replica snapshots and submits
-    happen outside).  A new lock domain or a new nesting MUST show up
-    here as a reviewed diff, not as drift."""
+    (_judge_locked's rollback-target reads), the FleetRouter's lock
+    over the same obs-instrument leaves (ISSUE 14, mirroring the
+    dispatcher's pattern), and — since ISSUE 15's causal traces
+    (DESIGN.md §19) — dispatcher/router → TraceStore (completed-trace
+    publication at the exactly-once _finish choke points; a leaf-lock
+    deque append).  The timeline and rule-engine locks are ISOLATED
+    leaf nodes by design (aggregate/evaluate take them with nothing
+    held).  A new lock domain or a new nesting MUST show up here as a
+    reviewed diff, not as drift."""
     g = build_graph(REPO)
     assert _edge_pairs(g) == {
         ("FleetRouter._lock", "CounterVec._lock"),
         ("FleetRouter._lock", "HistogramVec._lock"),
         ("FleetRouter._lock", "StreamingHistogram._lock"),
+        ("FleetRouter._lock", "TraceStore._lock"),
         ("MicroBatchDispatcher._lock", "CounterVec._lock"),
         ("MicroBatchDispatcher._lock", "HistogramVec._lock"),
         ("MicroBatchDispatcher._lock", "StreamingHistogram._lock"),
+        ("MicroBatchDispatcher._lock", "TraceStore._lock"),
         ("SceneRegistry._health_lock", "CounterVec._lock"),
         ("SceneRegistry._health_lock", "SceneManifest._lock"),
     }
+    # ISSUE 15: the new locks exist as nodes, and timeline/rules are
+    # leaf-isolated (no outgoing edges — nothing acquired under them).
+    for node in ("TraceStore._lock", "Timeline._lock",
+                 "RuleEngine._lock"):
+        assert node in g["nodes"], node
+        assert not any(src == node for src, _ in _edge_pairs(g)), node
     # The dispatcher's Condition aliases collapse onto one node.
     disp = g["nodes"]["MicroBatchDispatcher._lock"]
     assert disp["aliases"] == ["_space", "_work"]

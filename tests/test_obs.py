@@ -514,6 +514,11 @@ def test_concurrent_snapshots_consistent_and_admission_unblocked():
     disp = MicroBatchDispatcher(_echo, cfg, trace=True,
                                 slo=SLOPolicy(deadline_ms=60_000.0),
                                 start_worker=False)
+    # ISSUE 15: the timeline + rule engine ride the same stress leg —
+    # attached BEFORE the witness so their leaf locks are wrapped and
+    # the observed order check covers them.
+    timeline = disp.obs.attach_timeline(window_s=0.02, max_windows=32)
+    engine = disp.obs.attach_health_rules()
     # Warm the sync path once so the fleet latency/stage histogram
     # children exist for the witness to wrap, then re-base the books so
     # the exact-accounting assertions below stay exact.
@@ -544,6 +549,11 @@ def test_concurrent_snapshots_consistent_and_admission_unblocked():
                 assert total == t["offered"], t
                 assert "# TYPE" in render_prometheus(snap)
                 json.dumps(snap)
+                # ISSUE 15: tick + evaluate race the servers/readers too
+                # (every tick takes instrument locks, every evaluate the
+                # timeline + engine leaf locks — all witnessed).
+                timeline.maybe_tick()
+                engine.maybe_evaluate()
         except Exception as e:  # noqa: BLE001
             errors.append(e)
 
@@ -570,7 +580,514 @@ def test_concurrent_snapshots_consistent_and_admission_unblocked():
     witness.assert_subgraph(committed)
     assert any(src == "MicroBatchDispatcher._lock"
                for (src, _dst) in witness.edges())
-    assert witness.hold_summary()["MicroBatchDispatcher._lock"]["count"] > 0
+    holds = witness.hold_summary()
+    assert holds["MicroBatchDispatcher._lock"]["count"] > 0
+    # ISSUE 15: the new leaf locks were exercised AND witnessed — and
+    # the observed edge into the trace store (publication under the
+    # dispatch lock) is exactly the committed nesting.
+    for node in ("TraceStore._lock", "Timeline._lock",
+                 "RuleEngine._lock"):
+        assert holds[node]["count"] > 0, node
+    assert ("MicroBatchDispatcher._lock", "TraceStore._lock") \
+        in witness.edges()
+    # The rule evaluation over live mid-traffic windows stayed quiet.
+    assert engine.snapshot()["active"] == {}
+
+
+# ---------------- ISSUE 15: causal traces / timeline / rules ----------
+
+def _mk_fleet(trace_sample=1, watchdog_ms=60_000.0, n_reps=2):
+    from esac_tpu.fleet import FleetPolicy, FleetRouter, Replica
+
+    injs = [FaultInjector(_echo, tag=f"r{i}") for i in range(n_reps)]
+    slo = SLOPolicy(deadline_ms=60_000.0, watchdog_ms=watchdog_ms,
+                    watchdog_poll_ms=10.0)
+    reps = [Replica(f"r{i}", MicroBatchDispatcher(inj, CFG, slo=slo))
+            for i, inj in enumerate(injs)]
+    router = FleetRouter(reps, FleetPolicy(poll_ms=2.0,
+                                           trace_sample=trace_sample))
+    return router, injs
+
+
+def test_fleet_trace_telescopes_and_nests_dispatch_spans():
+    """Tentpole acceptance: a sampled fleet request's trace partitions
+    [t_submit, t_done] into routing / replica / outcome segments whose
+    fsum is EXACTLY the end-to-end latency, with the replica dispatch
+    riding as a child span carrying the dispatcher's own stage chain
+    (which telescopes in ITS clock domain)."""
+    router, _ = _mk_fleet()
+    try:
+        req = router.submit(_frame(1.0), scene="sA", deadline_ms=30_000)
+        req.get(30.0)
+        deadline = time.time() + 5
+        while not (req.trace and req.trace.done) and time.time() < deadline:
+            time.sleep(0.005)
+        tr = req.trace
+        assert tr is not None and tr.done and tr.outcome == "served"
+        stages = [s for s, _ in tr.root.segments()]
+        assert stages == ["routing", "replica", "served"]
+        assert tr.residual() < 1e-9
+        assert tr.total() == pytest.approx(req.t_done - req.t_submit)
+        dsp = [s for s in tr.spans if s.kind == "dispatch"]
+        assert len(dsp) == 1 and dsp[0].name == "replica:r0"
+        # the child chain telescopes on its own: stage dts sum to span
+        assert math.fsum(dt for _, dt in dsp[0].stages) == pytest.approx(
+            dsp[0].t1 - dsp[0].t0)
+        assert {"coalesced", "device", "served"} <= {
+            s for s, _ in dsp[0].stages}
+        # the routing decision rode as an event span
+        kinds = [(s.name, s.annotations.get("route_kind"))
+                 for s in tr.spans if s.name == "route_decision"]
+        assert kinds and kinds[0][1] in ("cold", "affinity", "dense")
+        # and the trace landed in the router's ring-bounded store
+        store = router.obs.get_trace_store()
+        assert any(t.trace_id == tr.trace_id for t in store.traces())
+    finally:
+        router.close()
+
+
+def test_fleet_trace_telescopes_exactly_across_failover():
+    """Satellite 3 acceptance: a watchdog-typed wedge fails the traced
+    request over to the surviving replica and the trace STILL
+    telescopes exactly — root stages show the failover sibling, the two
+    dispatch spans link retry_of, and the quarantine event is
+    annotated."""
+    router, injs = _mk_fleet(watchdog_ms=200.0)
+    try:
+        # Seed the scene's home onto r0, then wedge exactly r0.
+        router.infer_one(_frame(0.0), scene="sF", deadline_ms=30_000)
+        home = router.scene_homes()["sF"][0]
+        release = threading.Event()
+        for inj in injs:
+            inj.stall_once(release,
+                           match=lambda ctx, t=home: ctx["tag"] == t)
+        out = router.infer_one(_frame(2.0), scene="sF",
+                               deadline_ms=30_000)
+        assert float(out["echo"][0]) == 2.0
+        release.set()
+        store = router.obs.get_trace_store()
+        fo = [t for t in store.traces() if t.done
+              and len([s for s in t.spans if s.kind == "dispatch"]) > 1]
+        assert fo, "no failed-over trace captured"
+        tr = fo[-1]
+        stages = [s for s, _ in tr.root.segments()]
+        assert stages == ["routing", "replica", "failover_routing",
+                          "replica", tr.outcome]
+        assert tr.residual() < 1e-9
+        dsp = [s for s in tr.spans if s.kind == "dispatch"]
+        assert dsp[1].annotations["retry_of"] == dsp[0].span_id
+        assert dsp[0].annotations["replica"] != dsp[1].annotations["replica"]
+        events = {s.name for s in tr.spans if s.kind == "event"}
+        assert "replica_fault" in events
+    finally:
+        release.set()
+        router.close()
+
+
+def test_trace_rides_host_tier_demand_fault_with_exact_telescoping():
+    """Satellite 3: the registry fault path nests under a traced
+    dispatch — a cold demand fault records a weight_fault span (disk
+    source), a host-tier re-promotion records a host_tier one, and the
+    request chain still telescopes exactly around the multi-ms fault."""
+    from esac_tpu.registry import DeviceWeightCache
+    from esac_tpu.registry.hosttier import HostWeightTier
+
+    class Entry:
+        def __init__(self, key):
+            self.key = key
+
+    loads = []
+
+    def loader(entry):
+        loads.append(entry.key)
+        return {"w": np.full(4, 7.0, np.float32)}
+
+    tier = HostWeightTier(compression="none")
+    cache = DeviceWeightCache(loader, tier=tier)
+
+    def infer(tree, scene=None, route_k=None):
+        cache.get(Entry(("sc", 1)))
+        return {"echo": tree["x"]}
+
+    disp = MicroBatchDispatcher(infer, CFG, trace=True)
+    try:
+        req = disp.submit(_frame(1.0), scene="sc")
+        req.get(30.0)
+        tr = req.trace
+        assert tr is not None and tr.done
+        wf = [s for s in tr.spans if s.kind == "weight_fault"]
+        assert len(wf) == 1 and wf[0].annotations["source"] == "disk"
+        assert wf[0].annotations["coalesced"] is False
+        assert dict(wf[0].stages)["read_disk"] > 0
+        resid = abs(math.fsum(req.spans.durations().values())
+                    - (req.t_done - req.t_submit))
+        assert resid < 1e-9
+        # Demote to the host tier; the next fault is a host-tier hit.
+        cache.demote(("sc", 1))
+        req2 = disp.submit(_frame(2.0), scene="sc")
+        req2.get(30.0)
+        wf2 = [s for s in req2.trace.spans if s.kind == "weight_fault"]
+        assert wf2 and wf2[0].annotations["source"] == "host_tier"
+        assert loads == [("sc", 1)]  # one disk read ever
+        # Both traces landed in the dispatcher's store (slowest view).
+        store = disp.obs.get_trace_store()
+        assert store.added >= 2
+        assert store.slowest(1)[0]["total_s"] > 0
+    finally:
+        disp.close()
+
+
+def test_trace_annotates_prefetch_coalesced_demand_fault():
+    """A demand fault that coalesces onto an in-flight PREFETCH-issued
+    load is annotated as exactly that — at the cache level
+    (coalesced_with=prefetch when the prefetch owns the device-promote
+    future) and at the tier level (the prefetch_coalesced event when
+    the prefetch owns the disk read via preload_host)."""
+    from esac_tpu.obs import issuer_scope, trace_scope, Trace
+    from esac_tpu.registry import DeviceWeightCache
+    from esac_tpu.registry.hosttier import HostWeightTier
+
+    class Entry:
+        def __init__(self, key):
+            self.key = key
+
+    gate = threading.Event()
+
+    def loader(entry):
+        gate.wait(10.0)
+        return {"w": np.zeros(2, np.float32)}
+
+    tier = HostWeightTier(compression="none")
+    cache = DeviceWeightCache(loader, tier=tier)
+
+    def run_pair(prefetch_fn, entry):
+        """Start the prefetch-issued load, then a traced demand fault
+        racing it; release, join, return the demand's trace."""
+        gate.clear()
+        t_pf = threading.Thread(target=prefetch_fn)
+        t_pf.start()
+        deadline = time.time() + 5
+        while not (cache.stats()["loads_in_flight"]
+                   or tier.stats()["loads_in_flight"]) \
+                and time.time() < deadline:
+            time.sleep(0.002)
+        tr = Trace(time.perf_counter(), scene=str(entry.key),
+                   root_stage="admitted")
+        res = {}
+
+        def demand():
+            with trace_scope([tr]):
+                res["tree"] = cache.get(entry)
+
+        t_d = threading.Thread(target=demand)
+        t_d.start()
+        time.sleep(0.05)
+        gate.set()
+        t_pf.join(10)
+        t_d.join(10)
+        assert res["tree"] is not None
+        return tr
+
+    # (a) prefetch owns the CACHE-level future (device promote): the
+    # demand span is a coalesced wait annotated with the issuer.
+    e1 = Entry(("pc", 1))
+
+    def pf_dev():
+        with issuer_scope("prefetch"):
+            cache.get(e1)
+
+    tr = run_pair(pf_dev, e1)
+    wf = [s for s in tr.spans if s.kind == "weight_fault"]
+    assert wf and wf[0].annotations["coalesced"] is True
+    assert wf[0].annotations["coalesced_with"] == "prefetch"
+    # (b) prefetch owns the TIER-level future (preload_host): the
+    # demand owns the cache future but coalesces on the disk read —
+    # the tier records the prefetch_coalesced event on the trace.
+    e2 = Entry(("pc", 2))
+
+    def pf_host():
+        with issuer_scope("prefetch"):
+            cache.preload_host(e2)
+
+    tr2 = run_pair(pf_host, e2)
+    events = {s.name for s in tr2.spans if s.kind == "event"}
+    assert "prefetch_coalesced" in events
+    wf2 = [s for s in tr2.spans if s.kind == "weight_fault"]
+    assert wf2 and wf2[0].annotations["coalesced"] is False
+
+
+def test_timeline_ring_exactly_window_bounded_under_10k_stream():
+    """Satellite 3: 10k requests + many more ticks than the ring holds
+    -> the ring holds EXACTLY max_windows windows, each window's counter
+    deltas are exact (they sum to the totals), and the per-window
+    histogram quantiles come from the window's own samples."""
+    disp = MicroBatchDispatcher(_echo, CFG, start_worker=False)
+    tl = disp.obs.attach_timeline(window_s=1e-9, max_windows=16,
+                                  collectors=False)
+    total = 10_000
+    per_tick = 250
+    tl.tick()
+    for i in range(total // per_tick):
+        for j in range(per_tick):
+            disp.infer_one(_frame(j))
+        tl.tick()
+    wins = tl.windows()
+    assert len(wins) == 16  # EXACTLY the bound, not one more
+    assert tl.snapshot()["windows_retained"] == 16
+    for w in wins:
+        d = w["counters"]["serve_offered_total"][""]
+        assert d == per_tick
+        h = w["hist"]["serve_request_latency_seconds"][""]
+        assert h["count"] == per_tick and h["p50"] > 0
+        assert w["rates"]["serve_offered_total"][""] > 0
+    assert disp.obs.get("serve_offered_total").total() == total
+    disp.close()
+
+
+def test_timeline_survives_reset_stats_and_histogram_reset():
+    """The lifetime stream behind per-window deltas is monotone across
+    reset_stats: the post-reset window's histogram count is the NEW
+    observations only, and counter deltas follow the counter-reset
+    convention (value below baseline -> delta = value) instead of
+    recording a huge negative delta that would poison the burn-rate
+    denominator for a whole slow horizon (review regression)."""
+    disp = MicroBatchDispatcher(_echo, CFG, start_worker=False)
+    tl = disp.obs.attach_timeline(window_s=1e-9, max_windows=8,
+                                  collectors=False)
+    for i in range(5):
+        disp.infer_one(_frame(i))
+    tl.tick()
+    disp.reset_stats()  # clears window hists; lifetime keeps counting
+    for i in range(3):
+        disp.infer_one(_frame(i))
+    w = tl.tick()
+    h = w["hist"]["serve_request_latency_seconds"][""]
+    assert h["count"] == 3
+    # re-based counter: delta is the post-reset value (3), never -2.
+    assert w["counters"]["serve_offered_total"][""] == 3
+    assert all(d >= 0 for vals in w["counters"].values()
+               for d in vals.values()), w["counters"]
+    assert all(r >= 0 for vals in w["rates"].values()
+               for r in vals.values())
+    disp.close()
+
+
+def test_per_window_quantile_underflow_reports_floor_not_inf():
+    """Review regression: a window whose rank lands in the underflow
+    bucket reports the bucket floor, never +inf (which would leak
+    non-JSON-standard tokens into window records)."""
+    h = StreamingHistogram(lo=1e-3)
+    h.observe(5e-4)
+    h.observe(5e-4)
+    counts, n, _ = h.lifetime()
+    q = h.quantile_from_counts(counts, n, 0.5)
+    assert q == 1e-3 and math.isfinite(q)
+
+
+def _synthetic_timeline(registry):
+    from esac_tpu.obs.timeline import Timeline
+
+    return Timeline(registry, window_s=1e-9, max_windows=64)
+
+
+def test_rule_engine_burn_rate_golden_trip_and_recovery():
+    from esac_tpu.obs import MetricsRegistry, default_rules, RuleEngine
+
+    r = MetricsRegistry()
+    offered = r.counter("serve_offered_total")
+    outcomes = r.counter("serve_outcomes_total")
+    tl = _synthetic_timeline(r)
+    eng = RuleEngine(tl, default_rules(), registry=r)
+    tl.tick()
+    # Healthy windows: plenty offered, nothing bad -> quiet.
+    for _ in range(4):
+        offered.inc(50)
+        outcomes.inc(50, outcome="served")
+        tl.tick()
+    assert eng.evaluate() == []
+    # Burn: 30% shed across fast AND slow windows -> trips.
+    for _ in range(3):
+        offered.inc(50)
+        outcomes.inc(35, outcome="served")
+        outcomes.inc(15, outcome="shed")
+        tl.tick()
+    firing = eng.evaluate()
+    assert [a.rule for a in firing] == ["slo_burn_rate"]
+    assert firing[0].value >= 0.1 and firing[0].severity == "page"
+    # Edge-triggering: still firing -> no NEW raise event.
+    n_events = len(eng.alerts())
+    eng.evaluate()
+    assert len(eng.alerts()) == n_events
+    # Recovery: healthy windows push the fast frac back down -> clear.
+    for _ in range(6):
+        offered.inc(200)
+        outcomes.inc(200, outcome="served")
+        tl.tick()
+    assert eng.evaluate() == []
+    edges = [e.get("edge") for e in eng.alerts()]
+    assert edges == ["raise", "clear"]
+    # The instruments published: counter + active gauge.
+    assert r.get("health_alerts_total").get(rule="slo_burn_rate",
+                                            edge="raise") == 1
+    assert r.get("health_alert_active").get(rule="slo_burn_rate") == 0.0
+
+
+def test_rule_engine_bad_frac_slope_golden_trip():
+    from esac_tpu.obs import MetricsRegistry, RuleEngine
+    from esac_tpu.obs.rules import BadFracSlopeRule
+
+    r = MetricsRegistry()
+    bad_frac = {"v": 0.0}
+    r.register_collector(
+        "scene_health",
+        lambda: {"scenes": {"s0@v1": {"bad_frac": bad_frac["v"]},
+                            "s1@v1": {"bad_frac": 0.01}}},
+    )
+    tl = _synthetic_timeline(r)
+    eng = RuleEngine(tl, (BadFracSlopeRule(),), registry=r)
+    # Flat series -> quiet (a noisy-but-flat breaker must not fire).
+    for _ in range(8):
+        tl.tick()
+    assert eng.evaluate() == []
+    # Steady drift up, well under any trip threshold -> fires on SLOPE.
+    for i in range(8):
+        bad_frac["v"] = 0.05 * i
+        tl.tick()
+    firing = eng.evaluate()
+    assert len(firing) == 1
+    a = firing[0]
+    assert a.rule == "scene_bad_frac_slope"
+    assert "s0@v1" in a.labels["path"]  # the drifting scene, not s1
+    assert a.value >= 0.02
+
+
+def test_rule_engine_quiet_fleet_raises_nothing():
+    """Golden quiet case: a healthy serving fleet (real dispatcher
+    traffic, all served) evaluates the FULL default catalog to zero
+    alerts, and the snapshot carries empty active/events blocks."""
+    disp = MicroBatchDispatcher(_echo, CFG, start_worker=False)
+    tl = disp.obs.attach_timeline(window_s=1e-9, max_windows=32)
+    eng = disp.obs.attach_health_rules()
+    for i in range(40):
+        disp.infer_one(_frame(i), scene=f"s{i % 2}")
+        if i % 10 == 9:
+            tl.tick()
+    assert eng.evaluate() == []
+    snap = eng.snapshot()
+    assert snap["active"] == {} and snap["events"] == []
+    assert set(snap["rules"]) == {
+        "slo_burn_rate", "scene_bad_frac_slope", "prefetch_waste",
+        "affinity_sag", "queue_knee",
+    }
+    full = disp.obs.snapshot()
+    assert full["collectors"]["health_alerts"]["active"] == {}
+    json.dumps(full)
+    disp.close()
+
+
+# ---------------- ISSUE 15 satellite: export/CLI coverage --------------
+
+def test_every_registered_collector_is_known_and_renders():
+    """Schema pin: a FULL fleet's registered collector set must be
+    covered by export.KNOWN_COLLECTORS (a NEW collector cannot land
+    unrendered — adding it forces a reviewed entry here), and every
+    pinned numeric field renders as a real Prometheus sample."""
+    from esac_tpu.lint.witness import LockWitness
+    from esac_tpu.obs.export import KNOWN_COLLECTORS
+    from esac_tpu.registry import SceneManifest, SceneRegistry
+    from esac_tpu.fleet import FleetPolicy, FleetRouter, Replica
+
+    reg = SceneRegistry(SceneManifest())
+    disp = reg.dispatcher(CFG, start_worker=False)
+    reg.attach_prefetcher(start=False)
+    reg._prefetcher.bind_obs(disp.obs)
+    if reg.cache.tier is None:
+        from esac_tpu.registry.hosttier import HostWeightTier
+
+        HostWeightTier(compression="none").bind_obs(disp.obs)
+    LockWitness().bind_obs(disp.obs)
+    disp.obs.trace_store()
+    disp.obs.attach_health_rules()
+    router = FleetRouter(
+        [Replica("r0", MicroBatchDispatcher(_echo, CFG,
+                                            slo=SLOPolicy()))],
+        FleetPolicy(poll_ms=5.0), obs=disp.obs, start=False,
+    )
+    snap = disp.obs.snapshot()
+    registered = set(snap["collectors"])
+    unknown = registered - set(KNOWN_COLLECTORS)
+    assert not unknown, (
+        f"collectors {sorted(unknown)} not in export.KNOWN_COLLECTORS — "
+        "add them (and their key fields) so they render"
+    )
+    page = render_prometheus(snap)
+    for cname in registered:
+        assert f"# COLLECTOR {cname} " in page, cname
+        for field in KNOWN_COLLECTORS[cname]:
+            block = snap["collectors"][cname]
+            if isinstance(block, dict) and field in block \
+                    and isinstance(block[field], (int, float)) \
+                    and not isinstance(block[field], bool):
+                assert (f'esac_collector_value{{collector="{cname}",'
+                        f'path="{field}"}}') in page, (cname, field)
+    router.close(close_replicas=True)
+    disp.close()
+
+
+def test_prometheus_renders_collector_numeric_leaves():
+    r = MetricsRegistry()
+    r.register_collector("weight_cache",
+                         lambda: {"hits": 5, "nested": {"x": 2.5},
+                                  "skip": "str", "flag": True})
+    page = render_prometheus(r.snapshot())
+    assert 'esac_collector_value{collector="weight_cache",path="hits"} 5.0' \
+        in page
+    assert ('esac_collector_value{collector="weight_cache",'
+            'path="nested.x"} 2.5') in page
+    assert "flag" not in page and "skip" not in page.replace(
+        "# COLLECTOR", "")
+
+
+def test_obs_cli_traces_mode_renders_slowest(tmp_path, capsys):
+    from esac_tpu.obs.__main__ import main as obs_main
+
+    disp = MicroBatchDispatcher(_echo, CFG, trace=True)
+    try:
+        for i in range(4):
+            disp.infer_one(_frame(i), scene=f"s{i % 2}", timeout=30.0)
+    finally:
+        disp.close()
+    snap = disp.obs.snapshot()
+    f = tmp_path / "snap.json"
+    f.write_text(json.dumps(snap))
+    assert obs_main(["--file", str(f), "--traces", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "slowest sampled traces" in out
+    assert "trace t" in out and "served" in out
+    # a snapshot without traces says so instead of crashing
+    bare = tmp_path / "bare.json"
+    bare.write_text(json.dumps(MetricsRegistry().snapshot()))
+    assert obs_main(["--file", str(bare), "--traces"]) == 0
+    assert "no sampled traces" in capsys.readouterr().out
+
+
+def test_run_open_loop_records_trace_ids_and_exemplars():
+    disp = MicroBatchDispatcher(_echo, CFG, trace=True,
+                                slo=SLOPolicy(deadline_ms=30_000.0))
+    try:
+        res = run_open_loop(
+            disp, lambda i: (_frame(i), f"s{i % 2}", None),
+            uniform_arrivals(300.0, 20), deadline_ms=30_000.0,
+        )
+    finally:
+        disp.close()
+    ids = res["per_request_trace_ids"]
+    assert len(ids) == 20 and all(isinstance(t, str) for t in ids)
+    assert len(set(ids)) == 20
+    ex = res["exemplar_slow_traces"]
+    assert ex and ex[0]["total_s"] > 0
+    assert ex[0]["trace_id"] in ids
+    json.dumps(res["exemplar_slow_traces"])
 
 
 def test_snapshot_and_admission_never_block_on_wedged_dispatch():
