@@ -1361,10 +1361,16 @@ def _measure_chaos_at(root: pathlib.Path, seconds: float) -> dict:
     # cache under fault load) — attach BEFORE any traffic so the drill's
     # actual acquisition edges land in the artifact and are checked
     # against the committed .lock_graph.json partial order.
-    from esac_tpu.lint.witness import LockWitness
+    from esac_tpu.lint.witness import LockWitness, OutcomeWitness
 
     witness = LockWitness()
     witness.attach_fleet(registry=registry, injector=inj)
+    # graft-audit v5 runtime outcome witness (lint/witness.py): every
+    # error type the drill observes must be a committed taxonomy member
+    # and every (error type, outcome) pair must ride a committed
+    # raise->outcome edge from .fault_taxonomy.json — the dynamic half
+    # of R16's exhaustiveness gate, on real fault traffic.
+    outcome_witness = OutcomeWitness.from_repo(_REPO)
 
     def frame(i):
         return {
@@ -1447,6 +1453,7 @@ def _measure_chaos_at(root: pathlib.Path, seconds: float) -> dict:
     disp.reset_stats()
     res_a = open_loop(n_per_phase, seed=11)
     baseline = per_scene(res_a)
+    outcome_witness.observe_run(res_a)
 
     # ---- phase B: all three fault classes live under the same load ----
     registry.cache.evict(("s_corrupt", 1))
@@ -1459,6 +1466,7 @@ def _measure_chaos_at(root: pathlib.Path, seconds: float) -> dict:
     disp.reset_stats()
     res_b = open_loop(n_per_phase, seed=23)
     fault = per_scene(res_b)
+    outcome_witness.observe_run(res_b)
     totals_b = disp.slo_totals()
     accounting_exact = (
         all(rec["sums_to_offered"] for rec in fault.values())
@@ -1548,8 +1556,15 @@ def _measure_chaos_at(root: pathlib.Path, seconds: float) -> dict:
         )[:10],
     }
 
+    # graft-audit v5: the observed fault flow vs the committed taxonomy
+    # — the drill asserts (it is the acceptance leg for the outcome
+    # witness) AND records, so a green artifact carries the evidence.
+    fault_taxonomy = outcome_witness.snapshot()
+    outcome_witness.assert_consistent()
+
     return {
         "lock_witness": lock_witness,
+        "fault_taxonomy": fault_taxonomy,
         "scenes": {"n": len(scenes), "hw": [H, W], "num_experts": M,
                    "n_hyps": CHAOS_HYPS, "frame_bucket": CHAOS_BUCKET},
         "closed_loop_dispatch_ms": round(dispatch_s * 1e3, 2),
@@ -1794,9 +1809,13 @@ def _measure_fleet_at(root: pathlib.Path, seconds: float) -> dict:
     # attached before any worker/router thread starts (the witness
     # contract), checked against the committed .lock_graph.json at the
     # end, exactly like the chaos drill.
-    from esac_tpu.lint.witness import LockWitness
+    from esac_tpu.lint.witness import LockWitness, OutcomeWitness
 
     witness = LockWitness()
+    # graft-audit v5: the fleet drill is the second acceptance leg for
+    # the outcome witness — its records (incl. the forced-failover
+    # window) are held to the committed .fault_taxonomy.json edges.
+    outcome_witness = OutcomeWitness.from_repo(_REPO)
     # trace_sample=8: ALWAYS-ON sampled causal tracing across every leg
     # (ISSUE 15 — the obs gate bounds full-rate tracing at <= 3%, and
     # 1-in-8 divides it); the embedded obs snapshot's ``traces``
@@ -1856,6 +1875,8 @@ def _measure_fleet_at(root: pathlib.Path, seconds: float) -> dict:
             err = type(req.error).__name__ if req.error is not None \
                 else None
             out.append((s, fr, req, req.outcome or "lost", err))
+        for _, _, _, outcome, err in out:
+            outcome_witness.observe(err, outcome)
         return out
 
     def leg_summary(recs, span_s):
@@ -2047,6 +2068,9 @@ def _measure_fleet_at(root: pathlib.Path, seconds: float) -> dict:
     witness_snap = witness.snapshot()
     violations = (witness.violations(committed_graph)
                   if committed_graph is not None else None)
+    # graft-audit v5 acceptance: the whole drill's fault flow (incl.
+    # the wedge window's failovers) rode committed taxonomy edges.
+    outcome_witness.assert_consistent()
 
     return {
         "replicas": FLEET_REPLICAS,
@@ -2086,6 +2110,7 @@ def _measure_fleet_at(root: pathlib.Path, seconds: float) -> dict:
                 violations == [] if violations is not None else None
             ),
         },
+        "fault_taxonomy": outcome_witness.snapshot(),
         "obs_snapshot": obs_snapshot,
         "note": (
             "open-loop Zipf scene trace over a scene-affinity replica "

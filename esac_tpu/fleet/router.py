@@ -80,6 +80,7 @@ import time
 
 from esac_tpu.obs import MetricsRegistry, Trace
 from esac_tpu.serve.slo import (
+    ConfigError,
     DeadlineExceededError,
     DispatcherClosedError,
     DispatchStalledError,
@@ -96,6 +97,12 @@ class ReplicaQuarantinedError(ShedError):
     callers that only distinguish *admitted vs not* catch
     :class:`~esac_tpu.serve.slo.ShedError` — the exact contract
     ``LaneQuarantinedError`` set one level down."""
+
+    # NOT retryable, unlike LaneQuarantinedError: this is only raised
+    # once routing found NO healthy replica — there is nowhere else to
+    # retry until an operator releases one.
+    retryable = False
+    wire_name = "replica_quarantined"
 
 
 # FAILOVER-ELIGIBLE fault classes — another replica may well serve the
@@ -128,6 +135,11 @@ _REPLICA_INDICTING = (
 )
 
 OUTCOMES = ("served", "shed", "expired", "degraded", "failed")
+
+# close() drain budget for the completion/poll thread, seconds.  Orders
+# of magnitude above poll_ms, so a healthy loop always beats it; bounded
+# so a wedged relay cannot hang close() forever (graft-lint R18).
+_CLOSE_JOIN_S = 5.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -397,10 +409,12 @@ class FleetRouter:
                     outcome="failed",
                 )
         if thread is not None and not own:
-            # Now guaranteed to terminate: pending is drained, submit()
-            # rejects closed, so the loop's exit condition holds on its
-            # next poll.
-            thread.join()
+            # Pending is drained and submit() rejects closed, so the
+            # poll loop exits on its next tick; the join is bounded
+            # anyway (R18) — if the poll body itself is wedged on the
+            # relay, the daemon thread is abandoned, never waited on
+            # forever and never killed.
+            thread.join(_CLOSE_JOIN_S)
 
     def __enter__(self):
         return self
@@ -851,8 +865,8 @@ class FleetRouter:
         (relay recovery, a restarted worker) is fixed.  Idempotent;
         True when a quarantine was actually cleared."""
         if name not in self._replicas:
-            raise ValueError(f"unknown replica {name!r} "
-                             f"(fleet: {sorted(self._replicas)})")
+            raise ConfigError(f"unknown replica {name!r} "
+                              f"(fleet: {sorted(self._replicas)})")
         with self._lock:
             was = self._quarantined.pop(name, None)
             self._fail_streak.pop(name, None)
@@ -906,7 +920,9 @@ class FleetRouter:
                 try:
                     rep.registry.warm(scene)
                 except Exception:  # noqa: BLE001 — a failed warm skips,
-                    continue       # the demand path will retry typed
+                    # the demand path will retry typed; counted, not hidden
+                    self._m_events.inc(event="warm_failed")
+                    continue
             with self._lock:
                 if target not in self._quarantined:
                     self._claim_home_locked(scene, target)

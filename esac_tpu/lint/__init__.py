@@ -31,6 +31,7 @@ findings.  See LINT.md for the rule catalog and workflow.
 from esac_tpu.lint.findings import Finding, RULES
 from esac_tpu.lint.ast_rules import run_python_rules, run_registry_coverage
 from esac_tpu.lint.concurrency import run_concurrency_rules
+from esac_tpu.lint.faultflow import run_faultflow_rules
 from esac_tpu.lint.gradsafety import run_gradsafety_rules
 from esac_tpu.lint.lockgraph import run_lock_rules
 from esac_tpu.lint.shell_rules import run_shell_rules
@@ -42,6 +43,7 @@ __all__ = [
     "run_python_rules",
     "run_shell_rules",
     "run_concurrency_rules",
+    "run_faultflow_rules",
     "run_gradsafety_rules",
     "run_lock_rules",
     "run_registry_coverage",
@@ -62,14 +64,19 @@ def run_layer1(root, files=None):
     graft-audit v4 grad-safety dataflow pass (R14 unguarded domain-edge
     primitives + R15 where-VJP trap over the differentiated
     geometry/ransac/train scope; its jaxpr-level sibling J5 rides the
-    ledger).  The lock pass is fleet-global but skipped when a scoped run
-    touched no serve/registry/obs/lint file, and the grad pass likewise
+    ledger), and the graft-audit v5 fault-flow pass (R16 untyped raise /
+    taxonomy contract + R17 exception swallowing + R18 thread/future
+    lifecycle over fleet scope; the committed .fault_taxonomy.json DIFF
+    gate rides the CLI, ledger-style).  The lock and fault-flow passes
+    are fleet-global but skipped when a scoped run touched no
+    serve/registry/obs/fleet/lint file, and the grad pass likewise
     skips unless a geometry/ransac/train/lint file changed (--changed
     fast mode)."""
     findings = run_python_rules(root, files=files)
     findings += run_shell_rules(root, files=files)
     findings += run_concurrency_rules(root, files=files)
     findings += run_lock_rules(root, files=files)
+    findings += run_faultflow_rules(root, files=files)
     findings += run_gradsafety_rules(root, files=files)
     findings += run_registry_coverage(root, files=files)
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

@@ -7,18 +7,22 @@ Modes
 -----
 - default           : layer 1 over the full tree (incl. the graft-audit v3
                       R12/R13 fleet concurrency analysis + the lock-graph
-                      diff vs the committed .lock_graph.json, and the
+                      diff vs the committed .lock_graph.json, the
                       graft-audit v4 R14/R15 grad-safety dataflow pass
                       over the differentiated geometry/ransac/train
-                      scope) + layer 2 (jaxpr audit + resource-ledger
+                      scope, and the graft-audit v5 R16/R17/R18
+                      fault-flow pass + taxonomy diff vs the committed
+                      .fault_taxonomy.json) + layer 2 (jaxpr audit +
+                      resource-ledger
                       diff vs the committed .jaxpr_ledger.json, incl. the
                       J5 backward-jaxpr grad-hazard census); full-tree
                       runs also sweep for stale inline suppressions and
                       stale R11 waivers
 - ``--changed``     : layer 1 over git-modified/untracked files only; the
                       jaxpr audit AND the ledger run only when a traced
-                      package file changed, the lock-graph pass only
-                      when a serve/registry/obs/lint file changed, and
+                      package file changed, the lock-graph and
+                      fault-flow passes only when a
+                      serve/registry/obs/fleet/lint file changed, and
                       the grad-safety pass only when a
                       geometry/ransac/train/lint file changed (fast
                       pre-commit mode)
@@ -35,6 +39,10 @@ Modes
 - ``--write-lock-graph``: regenerate .lock_graph.json from the current
                       fleet lock analysis (review the edges before
                       committing!)
+- ``--write-fault-taxonomy``: regenerate .fault_taxonomy.json from the
+                      current fleet fault-flow analysis (review the
+                      error catalog + raise->outcome edges before
+                      committing!)
 
 The jaxpr audit itself forces the CPU backend before any device use — the
 lint must never become the second stuck TPU client it lints against
@@ -49,6 +57,7 @@ import subprocess
 import sys
 
 from esac_tpu.lint import run_layer1
+from esac_tpu.lint import faultflow
 from esac_tpu.lint import lockgraph
 from esac_tpu.lint.findings import RULES, Finding
 from esac_tpu.lint.suppress import (
@@ -141,6 +150,9 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--write-lock-graph", action="store_true",
                         help="regenerate .lock_graph.json from the "
                              "current fleet lock analysis")
+    parser.add_argument("--write-fault-taxonomy", action="store_true",
+                        help="regenerate .fault_taxonomy.json from the "
+                             "current fleet fault-flow analysis")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
@@ -176,6 +188,23 @@ def main(argv: list[str] | None = None) -> int:
             f"{len(graph['edges'])} edge(s) to "
             f"{root / lockgraph.LOCK_GRAPH_NAME} — review the diff before "
             "committing"
+        )
+        return 0
+
+    if args.write_fault_taxonomy:
+        try:
+            taxonomy = faultflow.build_taxonomy(root)
+            faultflow.write_taxonomy(
+                root / faultflow.FAULT_TAXONOMY_NAME, taxonomy
+            )
+        except Exception as e:
+            _note(f"graft-lint: internal error writing fault taxonomy: {e!r}")
+            return 2
+        _note(
+            f"graft-lint: wrote {len(taxonomy['errors'])} error class(es) / "
+            f"{len(taxonomy['edges'])} raise->outcome edge(s) to "
+            f"{root / faultflow.FAULT_TAXONOMY_NAME} — review the catalog "
+            "before committing"
         )
         return 0
 
@@ -300,6 +329,47 @@ def main(argv: list[str] | None = None) -> int:
         for f in lock_findings:
             emit(f)
 
+    # Fault-taxonomy diff gate (graft-audit v5, same ledger pattern):
+    # the R16/R17/R18 analysis findings already rode run_layer1; here
+    # the CURRENT error catalog + raise->outcome edge set is held to
+    # the committed .fault_taxonomy.json — an unreviewed new error
+    # class or edge fails, site/provenance drift reports stale.
+    fault_findings: list[Finding] = []
+    fault_ran = False
+    if faultflow.fault_pass_needed(files) and \
+            (root / "esac_tpu" / "lint" / "registry.py").exists():
+        try:
+            current_tax = faultflow.build_taxonomy(root)
+            fault_ran = True
+            committed_tax = faultflow.load_taxonomy(
+                root / faultflow.FAULT_TAXONOMY_NAME
+            )
+            if committed_tax is None:
+                # An EMPTY current catalog has nothing to gate (tiny
+                # audited trees in tests); any error or edge demands
+                # the committed artifact.
+                if current_tax["errors"] or current_tax["edges"]:
+                    fault_findings = [Finding(
+                        "R16", faultflow.FAULT_TAXONOMY_NAME, 0,
+                        "missing-fault-taxonomy",
+                        "no committed fault taxonomy; run "
+                        "`python -m esac_tpu.lint "
+                        "--write-fault-taxonomy`, review the error "
+                        "catalog and raise->outcome edges, and commit "
+                        "the file",
+                    )]
+            else:
+                fault_findings, fault_stale = faultflow.diff_taxonomy(
+                    committed_tax, current_tax
+                )
+                for note in fault_stale:
+                    _note(f"graft-lint: {note}")
+        except Exception as e:
+            _note(f"graft-lint: internal error in fault-taxonomy gate: {e!r}")
+            return 2
+        for f in fault_findings:
+            emit(f)
+
     audit_failures: list[Finding] = []
     ledger_findings: list[Finding] = []
     if not args.no_jaxpr and _audit_needed(files):
@@ -330,12 +400,14 @@ def main(argv: list[str] | None = None) -> int:
         for f in audit_failures + ledger_findings:
             emit(f)
 
-    n = (len(findings) + len(lock_findings) + len(audit_failures)
-         + len(ledger_findings))
+    n = (len(findings) + len(lock_findings) + len(fault_findings)
+         + len(audit_failures) + len(ledger_findings))
     scope = "changed files" if args.changed else ("paths" if args.paths else "tree")
     extras = []
     if lock_ran:
         extras.append("lock graph")
+    if fault_ran:
+        extras.append("fault taxonomy")
     if not args.no_jaxpr and _audit_needed(files):
         extras.append("jaxpr audit + ledger")
     summary = (f"graft-lint: {n} finding(s) over {scope}"

@@ -172,6 +172,38 @@ RULES = {
         "safe_norm/safe_sqrt/select-clamp idioms exist to avoid; guard "
         "the OPERAND (x / where(bad, 1.0, d)), not the result",
     ),
+    "R16": (
+        "untyped raise / taxonomy-contract violation in fleet scope "
+        "(bare builtin exception minted outside __init__, missing "
+        "retryable/wire_name, error with no outcome class, or an "
+        "unreviewed .fault_taxonomy.json entry)",
+        "LINT.md graft-audit v5 / DESIGN.md §20: every fault in the "
+        "serving fleet must be a member of the closed "
+        "ServeError/ManifestError taxonomy — typed, carrying retryable "
+        "and a stable wire_name (ROADMAP item-2 serialization seam), and "
+        "mapped to at least one accounted outcome class; "
+        "constructor-argument validation confined to "
+        "__init__/__post_init__ is the sanctioned near-miss",
+    ),
+    "R17": (
+        "broad except swallows: neither re-raises, converts to a typed "
+        "error, resolves a future/_finish, nor records a counter/outcome",
+        "LINT.md graft-audit v5 / DESIGN.md §13: a fault must end in "
+        "exactly one accounted outcome — the BaseException guards in "
+        "registry/cache.py and serve/dispatcher.py that resolve per-key "
+        "futures and re-raise are the allowlisted shape (matched "
+        "structurally); `except Exception: pass` is the flagged one",
+    ),
+    "R18": (
+        "thread/future lifecycle hazard: non-daemon Thread, bare "
+        "join(), or a per-key load future without an all-exit-paths "
+        "owner",
+        "LINT.md graft-audit v5 / CLAUDE.md environment hazards as a "
+        "rule: a thread wedged on the TPU relay can never be killed — "
+        "fleet threads must be daemon with a bounded join(timeout)-"
+        "then-abandon close path, and a minted load future must be "
+        "set() on every exit (an un-set Event strands waiters forever)",
+    ),
     # Layer-2 (jaxpr auditor) finding ids, reported with path = the
     # registry entry name:
     "J1": (

@@ -1,4 +1,16 @@
-"""Runtime lock witness (graft-audit v3): the dynamic half of R12/R13.
+"""Runtime witnesses: the dynamic halves of the committed-artifact gates.
+
+Two witnesses live here — :class:`LockWitness` (graft-audit v3, the
+dynamic half of R12/R13 vs ``.lock_graph.json``) and
+:class:`OutcomeWitness` (graft-audit v5, the dynamic half of R16 vs
+``.fault_taxonomy.json``: every error type a drill observes must be a
+committed taxonomy member, and every observed (error type, outcome)
+pair must ride a committed raise->outcome edge).  Both follow the same
+contract: production code never imports this module; tests and benches
+attach a witness, run the fleet, and assert against the committed
+artifact.
+
+Runtime lock witness (graft-audit v3): the dynamic half of R12/R13.
 
 The static pass (:mod:`esac_tpu.lint.lockgraph`) derives the fleet's
 lock-acquisition partial order from the AST; this module checks the
@@ -312,4 +324,142 @@ class LockWitness:
                 "observed lock acquisitions escape the committed order "
                 "(regenerate + review .lock_graph.json if intentional):\n"
                 + "\n".join(v)
+            )
+
+
+class OutcomeWitness:
+    """Runtime outcome witness (graft-audit v5): holds every error type
+    and (error type, outcome) pair a drill observes to the committed
+    ``.fault_taxonomy.json``.
+
+    The static pass (:mod:`esac_tpu.lint.faultflow`) proves each
+    taxonomy error is DISPOSED somewhere — mapped to an accounted
+    outcome class via a typed handler, a recorder call, or a broad
+    accounting backstop.  This witness checks the same contract on the
+    trail a real run leaves behind: ``bench.py chaos`` and the fleet
+    drill feed it the loadgen's ``per_request_outcomes`` /
+    ``per_request_error_types`` arrays, and :meth:`violations` reports
+
+    - an observed error type that is NOT a committed taxonomy member
+      (someone minted outside the closed catalog — the runtime shadow
+      of an R16 finding), and
+    - an observed (error type, outcome) pair outside the committed
+      effective edges (direct + taxonomy-ancestor edges + the wildcard
+      backstop: :func:`esac_tpu.lint.faultflow.effective_outcomes`) —
+      a disposal path the static map does not know about, or an
+      outcome string outside the closed vocabulary.
+
+    Requests that finished without an error (``error_type`` None) only
+    have their outcome checked against the vocabulary.  Like the lock
+    witness, the check is one-sided: a committed edge no drill happens
+    to take is stale-report territory for the static differ, never a
+    runtime violation."""
+
+    def __init__(self, taxonomy: dict):
+        from esac_tpu.lint.faultflow import effective_outcomes
+
+        self._taxonomy = taxonomy
+        self._effective = effective_outcomes(taxonomy)
+        self._vocabulary = tuple(taxonomy.get("outcome_classes", ()))
+        self._mu = threading.Lock()
+        self._pairs: collections.Counter = collections.Counter()
+        self._error_free: collections.Counter = collections.Counter()
+
+    @classmethod
+    def from_repo(cls, root) -> "OutcomeWitness":
+        """Build from the committed artifact at ``root`` (raises if it
+        is missing — a drill without a committed taxonomy is exactly
+        the gap the gate exists to close)."""
+        import pathlib
+
+        from esac_tpu.lint.faultflow import FAULT_TAXONOMY_NAME, load_taxonomy
+
+        taxonomy = load_taxonomy(pathlib.Path(root) / FAULT_TAXONOMY_NAME)
+        if taxonomy is None:
+            raise FileNotFoundError(
+                f"no committed {FAULT_TAXONOMY_NAME} under {root}; run "
+                "`python -m esac_tpu.lint --write-fault-taxonomy`"
+            )
+        return cls(taxonomy)
+
+    # ---- recording ----
+
+    def observe(self, error_type: str | None, outcome: str) -> None:
+        with self._mu:
+            if error_type:
+                self._pairs[(error_type, outcome)] += 1
+            else:
+                self._error_free[outcome] += 1
+
+    def observe_run(self, result: dict) -> "OutcomeWitness":
+        """Consume one loadgen summary dict (``run_open_loop`` /
+        ``FleetRouter`` drill shape): zips ``per_request_outcomes``
+        against ``per_request_error_types``."""
+        outcomes = result.get("per_request_outcomes", ())
+        err_types = result.get("per_request_error_types", ())
+        for outcome, err in zip(outcomes, err_types):
+            self.observe(err, outcome)
+        return self
+
+    # ---- reading / the gate ----
+
+    def pairs(self) -> dict[tuple[str, str], int]:
+        with self._mu:
+            return dict(self._pairs)
+
+    def violations(self) -> list[str]:
+        with self._mu:
+            pairs = dict(self._pairs)
+            error_free = dict(self._error_free)
+        out = []
+        for (err, outcome), n in sorted(pairs.items()):
+            if err not in self._effective:
+                out.append(
+                    f"{err} (x{n}): observed error type is not a member "
+                    "of the committed fault taxonomy"
+                )
+            elif outcome not in self._effective[err]:
+                out.append(
+                    f"{err}->{outcome} (x{n}): observed pair rides no "
+                    "committed raise->outcome edge (direct, inherited, "
+                    "or wildcard)"
+                )
+        for outcome, n in sorted(error_free.items()):
+            if outcome not in self._vocabulary:
+                out.append(
+                    f"(no error)->{outcome} (x{n}): outcome outside the "
+                    "committed vocabulary"
+                )
+        return out
+
+    def snapshot(self) -> dict:
+        """The ``fault_taxonomy`` obs collector / artifact block:
+        observed per-(error, outcome) counts, the violation list, and
+        the committed catalog size the run was held to."""
+        with self._mu:
+            pairs = dict(self._pairs)
+            error_free = dict(self._error_free)
+        return {
+            "observed": {f"{e}->{o}": n for (e, o), n in
+                         sorted(pairs.items())},
+            "error_free_outcomes": {o: n for o, n in
+                                    sorted(error_free.items())},
+            "violations": self.violations(),
+            "committed_errors": len(self._taxonomy.get("errors", {})),
+            "committed_edges": len(self._taxonomy.get("edges", [])),
+        }
+
+    def bind_obs(self, metrics, name: str = "fault_taxonomy") -> None:
+        """Publish the observed error->outcome trail into an obs
+        registry as a pull collector (the DESIGN.md §14 pattern the
+        lock witness uses)."""
+        metrics.register_collector(name, self.snapshot)
+
+    def assert_consistent(self) -> None:
+        v = self.violations()
+        if v:
+            raise AssertionError(
+                "observed fault flow escapes the committed taxonomy "
+                "(regenerate + review .fault_taxonomy.json if "
+                "intentional):\n" + "\n".join(v)
             )

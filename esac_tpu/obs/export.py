@@ -44,6 +44,8 @@ KNOWN_COLLECTORS = {
     "fleet": (),                          # per-replica merge (dynamic)
     # runtime lock witness (graft-audit v3; test/bench attach only)
     "lock_witness": (),
+    # runtime outcome witness (graft-audit v5; test/bench attach only)
+    "fault_taxonomy": ("committed_errors", "committed_edges"),
     # ISSUE 15: causal traces, time axis, health rules
     "traces": ("added", "retained"),
     "timeline": ("ticks", "windows_retained", "window_s"),
@@ -71,13 +73,13 @@ def jsonable(obj):
     if callable(item) and getattr(obj, "shape", None) in ((), None):
         try:
             return jsonable(item())
-        except Exception:  # noqa: BLE001 — fall through to repr
-            pass
+        except Exception:  # graft-lint: disable=R17(the repr fall-through after the try IS the disposal — outside the handler, invisible to the structural pass)
+            pass  # noqa: BLE001 — fall through to repr
     if hasattr(obj, "__iter__"):
         try:
             return [jsonable(v) for v in obj]
-        except Exception:  # noqa: BLE001 — fall through to repr
-            pass
+        except Exception:  # graft-lint: disable=R17(the repr fall-through after the try IS the disposal — outside the handler, invisible to the structural pass)
+            pass  # noqa: BLE001 — fall through to repr
     return repr(obj)
 
 

@@ -467,7 +467,7 @@ class MetricsRegistry:
             if m is None:
                 m = self._metrics[name] = factory()
             elif m.kind != kind:
-                raise ValueError(
+                raise ValueError(  # graft-lint: disable=R16(obs stays import-free of serve — no taxonomy available here; registration misuse is a programming error at wiring time, never a servable fault)
                     f"metric {name!r} already registered as {m.kind}, "
                     f"requested {kind}"
                 )
@@ -503,7 +503,7 @@ class MetricsRegistry:
             if have is None:
                 self._metrics[instrument.name] = instrument
             elif have is not instrument:
-                raise ValueError(
+                raise ValueError(  # graft-lint: disable=R16(obs stays import-free of serve — no taxonomy available here; registration misuse is a programming error at wiring time, never a servable fault)
                     f"metric {instrument.name!r} already registered with a "
                     "different instrument object"
                 )

@@ -315,6 +315,7 @@ class RuleEngine:
             maxlen=max_alerts
         )
         self._active: dict[str, Alert] = {}
+        self._eval_errors = 0
         self._last_ticks = -1
         self._m_alerts = None
         self._g_active = None
@@ -346,14 +347,16 @@ class RuleEngine:
         (not just the edges)."""
         windows = self._timeline.windows()
         firing: list[Alert] = []
+        eval_errors = 0
         for rule in self._rules:
             try:
                 firing.extend(rule.evaluate(windows))
             except Exception:  # noqa: BLE001 — one sick rule must not
-                continue       # silence the rest (snapshot contract)
+                eval_errors += 1  # silence the rest; counted, not hidden
         now = self._clock()
         by_key = {(a.rule, a.labels.get("path", "")): a for a in firing}
         with self._lock:
+            self._eval_errors += eval_errors
             rising = [a for k, a in by_key.items()
                       if k not in self._active]
             falling = [k for k in self._active if k not in by_key]
@@ -408,8 +411,10 @@ class RuleEngine:
             events = [dict(a) for a in self._alerts]
             active = {f"{r}|{p}" if p else r: a.to_dict()
                       for (r, p), a in self._active.items()}
+            eval_errors = self._eval_errors
         return {
             "rules": [r.name for r in self._rules],
             "active": active,
             "events": events,
+            "eval_errors": eval_errors,
         }

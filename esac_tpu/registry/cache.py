@@ -55,6 +55,7 @@ from collections.abc import Callable
 from typing import Any
 
 from esac_tpu.obs.trace import active_traces, current_issuer
+from esac_tpu.serve.slo import ConfigError
 
 
 def tree_nbytes(tree: Any) -> int:
@@ -285,7 +286,7 @@ class DeviceWeightCache:
         either tier (a device-resident key's payload is retained by
         this cache, so re-reading disk for it would be pure waste)."""
         if self.tier is None:
-            raise ValueError("preload_host needs a host tier attached")
+            raise ConfigError("preload_host needs a host tier attached")
         key = entry.key
         with self._lock:
             resident = key in self._trees

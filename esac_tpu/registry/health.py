@@ -49,6 +49,7 @@ class SceneLoadError(ManifestError, ServeError):
     loader already retried the transient window."""
 
     retryable = False
+    wire_name = "scene_load"
 
 
 class ChecksumMismatchError(SceneLoadError):
@@ -56,6 +57,9 @@ class ChecksumMismatchError(SceneLoadError):
     entry's recorded checksum: corrupt at rest, corrupted in the read
     path, or pointing at the wrong weights.  Serving it would be
     silently-garbage poses; failing typed is the contract."""
+
+    retryable = False
+    wire_name = "checksum_mismatch"
 
 
 class SceneUnhealthyError(ServeError):
@@ -65,6 +69,7 @@ class SceneUnhealthyError(ServeError):
     ``release_lane``)."""
 
     retryable = False
+    wire_name = "scene_unhealthy"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -49,6 +49,7 @@ from typing import Any
 import numpy as np
 
 from esac_tpu.obs.trace import active_traces, current_issuer
+from esac_tpu.serve.slo import ConfigError
 
 # Top-level subtrees of a load_scene_params tree that hold CNN weights —
 # the only leaves a lossy codec may touch.
@@ -147,7 +148,7 @@ def compress_tree(tree: Any, compression: str) -> dict:
     notably every :data:`EXACT_KEYS` geometry leaf — is stored
     byte-exact."""
     if compression not in COMPRESSION_CODECS:
-        raise ValueError(
+        raise ConfigError(
             f"compression {compression!r} not in {COMPRESSION_CODECS}"
         )
     if not isinstance(tree, dict):

@@ -56,7 +56,16 @@ CHECKSUM_ROLES = ("expert", "gating")
 
 
 class ManifestError(ValueError):
-    """A manifest (or one of its entries/checkpoints) failed validation."""
+    """A manifest (or one of its entries/checkpoints) failed validation.
+
+    Taxonomy root alongside ``ServeError`` (graft-audit v5): every
+    member carries an explicit literal ``retryable`` flag and a stable
+    ``wire_name`` (the item-2 serialization identity).  Manifest
+    validation is deterministic — retrying cannot fix a malformed
+    entry."""
+
+    retryable = False
+    wire_name = "manifest"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -157,6 +157,7 @@ class WeightPrefetcher:
         self.prefetch_hits = 0
         self.prefetch_wasted = 0
         self.prefetch_failures = 0
+        self.feed_errors = 0
         self.cycles = 0
 
     # ---- the arrival feed (dispatcher hot path; must never block) ----
@@ -170,7 +171,8 @@ class WeightPrefetcher:
             with self._lock:
                 self._arrivals.append((scene, t))
         except Exception:  # noqa: BLE001 — the feed must never hurt serving
-            pass
+            with self._lock:
+                self.feed_errors += 1
 
     # ---- lifecycle ----
 
@@ -358,6 +360,7 @@ class WeightPrefetcher:
                 "hits": self.prefetch_hits,
                 "wasted": self.prefetch_wasted,
                 "failures": self.prefetch_failures,
+                "feed_errors": self.feed_errors,
                 "cycles": self.cycles,
                 "in_credit": len(self._credit),
                 "tracked_scenes": len(self._scores),

@@ -333,9 +333,9 @@ def make_routed_scene_bucket_fn(preset: ScenePreset, cfg: RansacConfig,
 
     M = preset.num_experts
     if not 1 <= k <= M:
-        raise ValueError(f"routed top-k {k} outside 1..{M}")
+        raise ManifestError(f"routed top-k {k} outside 1..{M}")
     if k < M and not preset.gated:
-        raise ValueError(
+        raise ManifestError(
             "routed serving with k < num_experts needs a gated preset: "
             "without a gating net every frame would ride the same "
             "arbitrary expert subset"
@@ -518,7 +518,7 @@ class SceneRegistry:
             route_k = entry.ransac.serve_topk
         if n_hyps is not None and n_hyps < 1:
             # Fail at the boundary, not with a shape error inside jit.
-            raise ValueError(f"n_hyps override must be >= 1, got {n_hyps}")
+            raise ManifestError(f"n_hyps override must be >= 1, got {n_hyps}")
         if n_hyps == entry.ransac.n_hyps:
             n_hyps = None  # the scene's own budget: same program, one key
         # NOTE: like route_k, every distinct override is a PERMANENT cached
@@ -656,7 +656,7 @@ class SceneRegistry:
                 "verdict IS its health record)"
             )
         if not 0.0 < canary < 1.0:
-            raise ValueError(f"canary fraction {canary} outside (0, 1)")
+            raise ManifestError(f"canary fraction {canary} outside (0, 1)")
         entry = self.manifest.entry(scene_id, version)
         incumbent = self.manifest.active_version(scene_id)
         if incumbent == version:
@@ -998,7 +998,7 @@ class SceneRegistry:
         from esac_tpu.registry.prefetch import PrefetchPolicy, WeightPrefetcher
 
         if self._prefetcher is not None:
-            raise ValueError("a prefetcher is already attached")
+            raise ManifestError("a prefetcher is already attached")
         pf = WeightPrefetcher(self, policy or PrefetchPolicy(),
                               clock=self._clock)
         self._prefetcher = pf
@@ -1158,7 +1158,7 @@ def make_registry_sharded_serve_fn(
             # Routing decides which expert CNNs RUN; this path receives
             # precomputed coords_all, so there is nothing left to route.
             # Fail precisely instead of with a dispatcher TypeError.
-            raise ValueError(
+            raise ManifestError(
                 "route_k is not supported on the coords-level sharded "
                 "registry path (expert CNNs run upstream); use "
                 "parallel.make_esac_infer_routed_frames_sharded for "

@@ -31,6 +31,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from esac_tpu.serve.slo import ConfigError
+
 # Smallest physical frame-batch any dispatch runs at (see module docstring).
 MIN_LANES = 2
 
@@ -39,11 +41,11 @@ def pick_bucket(n: int, buckets: tuple[int, ...]) -> int:
     """Smallest bucket >= n.  ``n`` above the largest bucket is a planning
     error — :func:`plan_dispatches` splits bulk requests first."""
     if n < 1:
-        raise ValueError(f"need at least one frame, got {n}")
+        raise ConfigError(f"need at least one frame, got {n}")
     for b in sorted(set(buckets)):
         if b >= n:
             return b
-    raise ValueError(f"{n} frames exceed the largest bucket {max(buckets)}")
+    raise ConfigError(f"{n} frames exceed the largest bucket {max(buckets)}")
 
 
 def _lanes(chunks: list[int], buckets: tuple[int, ...]) -> int:
@@ -75,7 +77,7 @@ def plan_dispatches(n: int, buckets: tuple[int, ...]) -> list[int]:
     (:func:`_plan_tail`).  Returns counts summing to ``n``; each count is
     padded up by the caller via :func:`pick_bucket`."""
     if n < 1:
-        raise ValueError(f"need at least one frame, got {n}")
+        raise ConfigError(f"need at least one frame, got {n}")
     big = max(buckets)
     plan = [big] * (n // big)
     rem = n - big * len(plan)
@@ -121,6 +123,6 @@ def pad_batch(batch: dict, bucket: int) -> tuple[dict, int]:
     n_valid = len(next(iter(batch.values())))
     lanes = max(bucket, MIN_LANES)
     if n_valid > bucket:
-        raise ValueError(f"{n_valid} frames do not fit bucket {bucket}")
+        raise ConfigError(f"{n_valid} frames do not fit bucket {bucket}")
     extra = lanes - n_valid
     return {k: _pad_leaf(v, extra) for k, v in batch.items()}, n_valid

@@ -113,6 +113,14 @@ from esac_tpu.serve.slo import (
     WorkerDiedError,
 )
 
+# close() join budgets, seconds (graft-lint R18: every join is bounded —
+# a thread wedged on the TPU relay can never be killed, only abandoned).
+# Legacy mode (no SLOPolicy) drains the whole queue, so its window is
+# generous; the watchdog exits within one poll of _closed.  Module-level
+# so tests can shrink them to drill the wedged-close path.
+_LEGACY_DRAIN_JOIN_S = 60.0
+_WATCHDOG_JOIN_S = 2.0
+
 
 class _Request:
     """One queued frame.  ``result``/``error`` are plain attributes for
@@ -1257,7 +1265,11 @@ class MicroBatchDispatcher:
             if not replaced and not worker.is_alive():
                 break
             if not replaced and self._slo is None:
-                worker.join()  # legacy mode: drain however long it takes
+                # Legacy mode drains the whole queue, but inside a
+                # bounded window (R18): a wedged relay must not hang
+                # close() forever — leftovers fail typed below and the
+                # daemon thread is abandoned, never killed.
+                worker.join(_LEGACY_DRAIN_JOIN_S)
                 break
         # Fail whatever could not drain (no worker ever started, worker
         # dead, quarantined lanes) so every waiter wakes.
@@ -1280,7 +1292,10 @@ class MicroBatchDispatcher:
                 )
             watchdog = self._watchdog
         if watchdog is not None and watchdog is not threading.current_thread():
-            watchdog.join()
+            # Exits within one watchdog poll of _closed; bounded join
+            # (R18) so even a wedged poll cannot hang close() — the
+            # daemon thread is abandoned past the budget.
+            watchdog.join(_WATCHDOG_JOIN_S)
 
     def __enter__(self):
         return self

@@ -32,7 +32,7 @@ import time
 
 import numpy as np
 
-from esac_tpu.serve.slo import DeadlineExceededError, ShedError
+from esac_tpu.serve.slo import ConfigError, DeadlineExceededError, ShedError
 
 # Outcome classes a request can end in (the accounting invariant's terms).
 OUTCOMES = ("served", "degraded", "shed", "expired", "failed")
@@ -42,7 +42,7 @@ def poisson_arrivals(rate_rps: float, n: int, seed: int = 0) -> np.ndarray:
     """Cumulative arrival times (seconds) of ``n`` Poisson arrivals at
     ``rate_rps``: i.i.d. exponential gaps, deterministic per seed."""
     if rate_rps <= 0:
-        raise ValueError(f"rate_rps {rate_rps} <= 0")
+        raise ConfigError(f"rate_rps {rate_rps} <= 0")
     gaps = np.random.RandomState(seed).exponential(1.0 / rate_rps, size=n)
     return np.cumsum(gaps)
 
@@ -50,7 +50,7 @@ def poisson_arrivals(rate_rps: float, n: int, seed: int = 0) -> np.ndarray:
 def uniform_arrivals(rate_rps: float, n: int) -> np.ndarray:
     """Cumulative arrival times of a deterministic constant-rate trace."""
     if rate_rps <= 0:
-        raise ValueError(f"rate_rps {rate_rps} <= 0")
+        raise ConfigError(f"rate_rps {rate_rps} <= 0")
     return (np.arange(n, dtype=np.float64) + 1.0) / rate_rps
 
 
@@ -99,7 +99,7 @@ def run_open_loop(
     arrivals = np.asarray(arrivals, np.float64)
     n = len(arrivals)
     if n == 0:
-        raise ValueError("empty arrival schedule")
+        raise ConfigError("empty arrival schedule")
     lane_hist = _lane_hist(disp)
     if lane_hist is not None:
         # Run-local lane views (see docstring): the per-lane histogram

@@ -45,14 +45,26 @@ class ServeError(RuntimeError):
     subclasses representing *deterministic* faults (a checksum mismatch,
     a breaker-shed scene — see esac_tpu.registry.health) set it False
     and the dispatcher fails the batch immediately instead of re-paying
-    the fault ``retry_max`` times."""
+    the fault ``retry_max`` times.
+
+    ``wire_name`` is the class's stable cross-process identity (the
+    ROADMAP item-2 serialization seam): a typed error crossing an RPC
+    wire is identified by this snake_case token, never by a Python
+    qualname, so classes can move between modules without breaking
+    peers.  Every taxonomy member declares BOTH attributes explicitly
+    as literals — graft-audit v5 (LINT.md R16) enforces it statically,
+    inheritance is deliberately not enough."""
 
     retryable = True
+    wire_name = "serve"
 
 
 class ShedError(ServeError):
     """Admission control rejected the request before it entered the queue
     (bounded queue full, or predicted wait exceeds the request's SLO)."""
+
+    retryable = True
+    wire_name = "shed"
 
 
 class LaneQuarantinedError(ShedError):
@@ -61,10 +73,18 @@ class LaneQuarantinedError(ShedError):
     A quarantine rejection is a shed (it happens at admission), so callers
     that only distinguish *admitted vs not* can catch :class:`ShedError`."""
 
+    # Retryable: other lanes (and, one tier up, other replicas) still
+    # serve — a re-submit routed elsewhere can succeed.
+    retryable = True
+    wire_name = "lane_quarantined"
+
 
 class DeadlineExceededError(ServeError):
     """The request missed its deadline — expired in the queue, or the
     caller's wait timed out before a result landed."""
+
+    retryable = True
+    wire_name = "deadline_exceeded"
 
 
 class DispatchStalledError(ServeError):
@@ -73,16 +93,43 @@ class DispatchStalledError(ServeError):
     letting callers hang — the relay-stall failure mode made a bounded,
     typed error."""
 
+    retryable = True
+    wire_name = "dispatch_stalled"
+
 
 class WorkerDiedError(ServeError):
     """The dispatcher's worker thread died with requests pending; nothing
     queued will ever dispatch.  Pending and future requests fail with
     this instead of stranding their callers forever."""
 
+    # Retryable: the fleet tier treats a dead worker as a replica fault
+    # and fails the request over to a surviving replica.
+    retryable = True
+    wire_name = "worker_died"
+
 
 class DispatcherClosedError(ServeError):
     """``close()`` ran while requests were still pending and no worker
     could drain them."""
+
+    # Closed is deliberate: nothing on THIS dispatcher will ever serve
+    # again (the fleet tier may still fail over, but that is routing,
+    # not a retry of the same dispatch).
+    retryable = False
+    wire_name = "dispatcher_closed"
+
+
+class ConfigError(ServeError, ValueError):
+    """Caller misuse of the serving API outside a constructor: a bad
+    argument to an already-built dispatcher/router/loadgen surface
+    (``route_k`` out of range, an unknown bucket, a non-positive rate).
+    Deterministic — retrying the same call cannot help.  Subclasses
+    ``ValueError`` too so pre-taxonomy callers (and tests) catching
+    ``ValueError`` keep working; ``__init__`` validation itself stays on
+    bare ``ValueError`` (the R16 sanctioned near-miss)."""
+
+    retryable = False
+    wire_name = "config"
 
 
 @dataclasses.dataclass(frozen=True)

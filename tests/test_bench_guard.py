@@ -676,6 +676,13 @@ def _canned_chaos():
                    "finalized": True, "active_version_after": 2},
         "compiled_programs": {"before_faults": 1, "after_drill": 1,
                               "hot_path_recompiles": 0},
+        "fault_taxonomy": {
+            "observed": {"ChecksumMismatchError->failed": 2,
+                         "LaneQuarantinedError->shed": 23},
+            "error_free_outcomes": {"served": 75},
+            "violations": [],
+            "committed_errors": 13, "committed_edges": 8,
+        },
         "health_events": [],
         "note": "canned",
     }
@@ -776,6 +783,14 @@ def test_chaos_artifact_schema_committed():
     assert lw["observed_subgraph_of_committed"] is True
     assert any(k.startswith("MicroBatchDispatcher._lock->")
                for k in lw["edges_observed"]), lw["edges_observed"]
+    # graft-audit v5: the runtime outcome witness rode the drill — every
+    # observed error type is a committed taxonomy member and every
+    # (error, outcome) pair rides a committed raise->outcome edge.
+    ft = chaos["fault_taxonomy"]
+    assert ft["violations"] == []
+    assert ft["observed"], "fault window produced no typed errors?"
+    assert ft["committed_errors"] >= 13
+    assert ft["committed_edges"] >= 1
 
 
 def test_all_mode_mains_share_the_wedge_safe_scaffold(monkeypatch):
@@ -864,6 +879,12 @@ def _canned_fleet():
             "MicroBatchDispatcher._lock->CounterVec._lock": 10,
         }, "committed_graph_present": True, "violations": [],
             "observed_subgraph_of_committed": True},
+        "fault_taxonomy": {
+            "observed": {"DispatchStalledError->failed": 1},
+            "error_free_outcomes": {"served": 200},
+            "violations": [],
+            "committed_errors": 13, "committed_edges": 8,
+        },
         "obs_snapshot": {"obs_schema": 1, "metrics": {}, "collectors": {}},
         "note": "canned",
     }
@@ -981,6 +1002,12 @@ def test_fleet_artifact_schema_committed():
     assert lw["observed_subgraph_of_committed"] is True
     assert any(k.startswith("FleetRouter._lock->")
                for k in lw["edges_observed"]), lw["edges_observed"]
+    # graft-audit v5: outcome witness over the whole drill, incl. the
+    # forced-failover window — violation-free against the committed
+    # .fault_taxonomy.json.
+    ft = fleet["fault_taxonomy"]
+    assert ft["violations"] == []
+    assert ft["committed_errors"] >= 13
     # Per-replica-labelled fleet merge in the embedded obs snapshot,
     # each replica's own books summing exactly.
     snap = fleet["obs_snapshot"]

@@ -1,0 +1,860 @@
+"""graft-audit v5 tests: the R16/R17/R18 fault-flow analysis, the
+committed fault-taxonomy artifact machinery, and the runtime outcome
+witness.
+
+Golden trigger + near-miss fixtures ride tmp_path trees mimicking the
+fleet layout (the pass is scoped to esac_tpu/{serve,registry,obs,fleet}/),
+exactly like test_lockgraph.py.  The repo-level gates — committed
+taxonomy matches the tree exactly, analysis clean — live in test_lint.py
+next to their lock-graph/ledger siblings; here the REAL taxonomy is
+pinned member-by-member so an error-contract change cannot slip through
+as "just drift".
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+from esac_tpu.lint.cli import main as lint_main
+from esac_tpu.lint.faultflow import (
+    FAULT_TAXONOMY_NAME,
+    OUTCOME_CLASSES,
+    build_taxonomy,
+    diff_taxonomy,
+    effective_outcomes,
+    fault_pass_needed,
+    load_taxonomy,
+    run_faultflow_rules,
+    write_taxonomy,
+)
+from esac_tpu.lint.witness import OutcomeWitness
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _write(root: pathlib.Path, rel: str, text: str) -> str:
+    p = root / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(text))
+    return rel
+
+
+# The minimal taxonomy every fixture tree shares: two members with the
+# full contract, plus the dispatcher-shaped broad accounting backstop
+# (a wildcard edge, so fixture raises don't trip the no-outcome gate
+# unless a test wants exactly that).
+_BASE = """\
+    class ServeError(RuntimeError):
+        retryable = True
+        wire_name = "serve"
+
+    class ShedError(ServeError):
+        retryable = True
+        wire_name = "shed"
+
+    class _Backstop:
+        def _run(self):
+            try:
+                self._dispatch()
+            except BaseException as e:
+                self._finish(e, outcome="failed")
+    """
+
+
+def _base_tree(tmp_path):
+    _write(tmp_path, "esac_tpu/serve/slo.py", _BASE)
+    return tmp_path
+
+
+def _texts(findings, rule=None):
+    return [f.text for f in findings if rule is None or f.rule == rule]
+
+
+# --------------------------------------------------------------------------
+# R16: untyped raise
+
+def test_r16_builtin_raise_in_fleet_scope_flags(tmp_path):
+    _base_tree(tmp_path)
+    _write(tmp_path, "esac_tpu/serve/bad.py", """\
+        class Dispatcher:
+            def submit(self, req):
+                raise ValueError("queue full")
+        """)
+    texts = _texts(run_faultflow_rules(tmp_path), "R16")
+    assert "raise:ValueError@esac_tpu/serve/bad.py::Dispatcher.submit" \
+        in texts
+
+
+def test_r16_init_validation_is_the_sanctioned_near_miss(tmp_path):
+    _base_tree(tmp_path)
+    _write(tmp_path, "esac_tpu/serve/ok.py", """\
+        class Policy:
+            def __init__(self, deadline_ms):
+                if deadline_ms <= 0:
+                    raise ValueError("deadline must be positive")
+
+        class Frozen:
+            def __post_init__(self):
+                if self.k < 1:
+                    raise ValueError("k must be >= 1")
+        """)
+    assert _texts(run_faultflow_rules(tmp_path), "R16") == []
+
+
+def test_r16_typed_raise_and_propagation_are_clean(tmp_path):
+    _base_tree(tmp_path)
+    _write(tmp_path, "esac_tpu/serve/ok.py", """\
+        from esac_tpu.serve.slo import ShedError
+
+        class Dispatcher:
+            def submit(self, req):
+                raise ShedError("queue full")
+
+            def relay(self, e):
+                raise e
+
+            def reraise(self):
+                try:
+                    self.submit(None)
+                except ShedError:
+                    raise
+        """)
+    assert _texts(run_faultflow_rules(tmp_path), "R16") == []
+    tax = build_taxonomy(tmp_path)
+    assert "esac_tpu/serve/ok.py::Dispatcher.submit" in \
+        tax["errors"]["ShedError"]["raise_sites"]
+
+
+def test_r16_inline_suppression_masks_the_finding(tmp_path):
+    _base_tree(tmp_path)
+    _write(tmp_path, "esac_tpu/serve/waived.py", """\
+        class Wiring:
+            def register(self, name):
+                raise ValueError(name)  # graft-lint: disable=R16(wiring-time programming error, never servable)
+        """)
+    assert _texts(run_faultflow_rules(tmp_path), "R16") == []
+
+
+# --------------------------------------------------------------------------
+# R16: taxonomy contract (retryable / wire_name / no-outcome)
+
+def test_r16_missing_contract_fields_flag(tmp_path):
+    _base_tree(tmp_path)
+    _write(tmp_path, "esac_tpu/serve/newerr.py", """\
+        from esac_tpu.serve.slo import ServeError
+
+        class HalfBakedError(ServeError):
+            pass
+        """)
+    texts = _texts(run_faultflow_rules(tmp_path), "R16")
+    assert "error:HalfBakedError:retryable" in texts
+    assert "error:HalfBakedError:wire_name" in texts
+
+
+def test_r16_duplicate_wire_name_flags(tmp_path):
+    _base_tree(tmp_path)
+    _write(tmp_path, "esac_tpu/serve/dup.py", """\
+        from esac_tpu.serve.slo import ServeError
+
+        class CloneError(ServeError):
+            retryable = False
+            wire_name = "shed"
+        """)
+    texts = _texts(run_faultflow_rules(tmp_path), "R16")
+    assert any(t in ("error:CloneError:wire_dup",
+                     "error:ShedError:wire_dup") for t in texts)
+
+
+def test_r16_raised_error_with_no_outcome_and_no_backstop_flags(tmp_path):
+    # No _Backstop: the tree has NO wildcard edge, so a minted error
+    # that lands in no outcome class is exactly the DESIGN.md §13 leak.
+    _write(tmp_path, "esac_tpu/serve/slo.py", """\
+        class ServeError(RuntimeError):
+            retryable = True
+            wire_name = "serve"
+
+        class LeakError(ServeError):
+            retryable = False
+            wire_name = "leak"
+
+        def submit(req):
+            raise LeakError("nobody accounts for me")
+        """)
+    texts = _texts(run_faultflow_rules(tmp_path), "R16")
+    assert "error:LeakError:no-outcome" in texts
+    # ServeError itself is never minted -> no no-outcome finding for it.
+    assert "error:ServeError:no-outcome" not in texts
+
+
+def test_r16_wildcard_backstop_satisfies_the_outcome_gate(tmp_path):
+    _base_tree(tmp_path)  # _Backstop carries the * -> failed edge
+    _write(tmp_path, "esac_tpu/serve/mint.py", """\
+        from esac_tpu.serve.slo import ShedError
+
+        def submit(req):
+            raise ShedError("full")
+        """)
+    texts = _texts(run_faultflow_rules(tmp_path), "R16")
+    assert not any(t.endswith(":no-outcome") for t in texts)
+
+
+# --------------------------------------------------------------------------
+# R17: exception swallowing
+
+def test_r17_silent_broad_except_flags(tmp_path):
+    _base_tree(tmp_path)
+    _write(tmp_path, "esac_tpu/serve/eater.py", """\
+        class Eater:
+            def poll(self):
+                try:
+                    self.step()
+                except Exception:
+                    pass
+        """)
+    texts = _texts(run_faultflow_rules(tmp_path), "R17")
+    assert texts == ["swallow:esac_tpu/serve/eater.py::Eater.poll"]
+
+
+def test_r17_disposal_shapes_are_near_misses(tmp_path):
+    """Re-raise, typed conversion, future-resolve, counter-record and
+    outcome-store all count as disposal — none flags."""
+    _base_tree(tmp_path)
+    _write(tmp_path, "esac_tpu/serve/fine.py", """\
+        from esac_tpu.serve.slo import ShedError
+
+        class Fine:
+            def a_reraise(self):
+                try:
+                    self.step()
+                except Exception:
+                    raise
+
+            def b_convert(self):
+                try:
+                    self.step()
+                except Exception as e:
+                    raise ShedError(str(e))
+
+            def c_future(self, fut):
+                try:
+                    self.step()
+                except BaseException as e:
+                    fut["error"] = e
+                    fut["event"].set()
+
+            def d_counter(self):
+                try:
+                    self.step()
+                except Exception:
+                    self.errors += 1
+
+            def e_finish(self, req):
+                try:
+                    self.step()
+                except Exception as e:
+                    self._finish_locked(req, error=e, outcome="failed")
+        """)
+    assert _texts(run_faultflow_rules(tmp_path), "R17") == []
+
+
+def test_r17_narrow_except_is_out_of_scope(tmp_path):
+    _base_tree(tmp_path)
+    _write(tmp_path, "esac_tpu/serve/narrow.py", """\
+        class Narrow:
+            def get(self, d, k):
+                try:
+                    return d[k]
+                except KeyError:
+                    return None
+        """)
+    assert _texts(run_faultflow_rules(tmp_path), "R17") == []
+
+
+# --------------------------------------------------------------------------
+# R18: thread/future lifecycle
+
+def test_r18_non_daemon_thread_flags_daemon_is_clean(tmp_path):
+    _base_tree(tmp_path)
+    _write(tmp_path, "esac_tpu/serve/threads.py", """\
+        import threading
+
+        class Runner:
+            def start_bad(self):
+                self.t = threading.Thread(target=self.run)
+                self.t.start()
+
+            def start_good(self):
+                self.t = threading.Thread(target=self.run, daemon=True)
+                self.t.start()
+        """)
+    texts = _texts(run_faultflow_rules(tmp_path), "R18")
+    assert texts == ["thread:esac_tpu/serve/threads.py::Runner.start_bad"]
+
+
+def test_r18_bare_join_flags_bounded_join_is_clean(tmp_path):
+    _base_tree(tmp_path)
+    _write(tmp_path, "esac_tpu/serve/joins.py", """\
+        class Closer:
+            def close_bad(self):
+                self.t.join()
+
+            def close_good(self):
+                self.t.join(5.0)
+        """)
+    texts = _texts(run_faultflow_rules(tmp_path), "R18")
+    assert texts == ["join:esac_tpu/serve/joins.py::Closer.close_bad"]
+
+
+def test_r18_future_owner_must_resolve_on_all_exit_paths(tmp_path):
+    _base_tree(tmp_path)
+    _write(tmp_path, "esac_tpu/registry/futures.py", """\
+        class Cache:
+            def load_bad(self, key):
+                fut = self._futures[key] = {"event": self._ev(),
+                                            "error": None}
+                value = self._read(key)
+                fut["event"].set()
+                return value
+
+            def load_good(self, key):
+                fut = self._futures[key] = {"event": self._ev(),
+                                            "error": None}
+                try:
+                    value = self._read(key)
+                except BaseException as e:
+                    fut["error"] = e
+                    fut["event"].set()
+                    raise
+                fut["event"].set()
+                return value
+        """)
+    texts = _texts(run_faultflow_rules(tmp_path), "R18")
+    assert texts == ["future:esac_tpu/registry/futures.py::Cache.load_bad"]
+
+
+# --------------------------------------------------------------------------
+# raise->outcome edge extraction
+
+def test_edges_from_recorder_call_typed_handler_and_raise_context(tmp_path):
+    _base_tree(tmp_path)
+    _write(tmp_path, "esac_tpu/serve/edges.py", """\
+        from esac_tpu.serve.slo import ShedError
+
+        def _admit(depth):
+            if depth > 8:
+                return ShedError("queue full")
+            return None
+
+        class Dispatcher:
+            def reject(self, req):
+                self._finish(req, ShedError("full"), outcome="shed")
+
+            def handle(self, req):
+                try:
+                    self.dispatch(req)
+                except ShedError as e:
+                    self._finish(req, e, outcome="degraded")
+
+            def submit(self, req):
+                why = _admit(req.depth)
+                if why is not None:
+                    self._count("expired")
+                    raise why
+        """)
+    tax = build_taxonomy(tmp_path)
+    edges = {(e["error"], e["outcome"]): e["via"] for e in tax["edges"]}
+    assert "esac_tpu/serve/edges.py::Dispatcher.reject" in \
+        edges[("ShedError", "shed")]
+    assert "esac_tpu/serve/edges.py::Dispatcher.handle" in \
+        edges[("ShedError", "degraded")]
+    assert "esac_tpu/serve/edges.py::Dispatcher.submit" in \
+        edges[("ShedError", "expired")]
+    # the base tree's broad backstop
+    assert ("*", "failed") in edges
+    # handler site recorded for the typed handler
+    assert "esac_tpu/serve/edges.py::Dispatcher.handle" in \
+        tax["errors"]["ShedError"]["handler_sites"]
+
+
+# --------------------------------------------------------------------------
+# artifact machinery: round-trip, diff gate, effective outcomes
+
+def _mint_tree(tmp_path):
+    _base_tree(tmp_path)
+    _write(tmp_path, "esac_tpu/serve/mint.py", """\
+        from esac_tpu.serve.slo import ShedError
+
+        class D:
+            def reject(self, req):
+                self._finish(req, ShedError("full"), outcome="shed")
+        """)
+    return tmp_path
+
+
+def test_taxonomy_round_trips_through_the_artifact(tmp_path):
+    _mint_tree(tmp_path)
+    tax = build_taxonomy(tmp_path)
+    write_taxonomy(tmp_path / FAULT_TAXONOMY_NAME, tax)
+    loaded = load_taxonomy(tmp_path / FAULT_TAXONOMY_NAME)
+    assert loaded["errors"] == tax["errors"]
+    assert loaded["edges"] == tax["edges"]
+    assert loaded["outcome_classes"] == list(OUTCOME_CLASSES)
+    assert load_taxonomy(tmp_path / "nope.json") is None
+
+
+def test_diff_taxonomy_clean_new_error_new_edge_drift_stale(tmp_path):
+    _mint_tree(tmp_path)
+    committed = build_taxonomy(tmp_path)
+    findings, stale = diff_taxonomy(committed, committed)
+    assert findings == [] and stale == []
+
+    # NEW error class + NEW edge -> findings (the review gate).
+    current = json.loads(json.dumps(committed))
+    current["errors"]["NewError"] = {
+        "module": "esac_tpu/serve/x.py", "bases": ["ServeError"],
+        "retryable": True, "wire_name": "new", "raise_sites": [],
+        "handler_sites": [], "outcomes": [],
+    }
+    current["edges"].append(
+        {"error": "NewError", "outcome": "failed", "via": ["x::f"]})
+    findings, stale = diff_taxonomy(committed, current)
+    assert sorted(f.text for f in findings) == \
+        ["edge:NewError->failed", "error:NewError"]
+    assert all(f.rule == "R16" for f in findings)
+
+    # Contract drift (retryable flip) -> finding; provenance drift and
+    # vanished entries -> stale notes, not findings.
+    current = json.loads(json.dumps(committed))
+    current["errors"]["ShedError"]["retryable"] = False
+    current["errors"]["ServeError"]["raise_sites"] = ["x::moved"]
+    findings, stale = diff_taxonomy(committed, current)
+    assert [f.text for f in findings] == ["contract:ShedError:retryable"]
+    assert any("raise_sites drifted" in s for s in stale)
+
+    findings, stale = diff_taxonomy(committed, {"errors": {}, "edges": []})
+    assert findings == []
+    assert any("no longer exists" in s for s in stale)
+    assert any("no longer taken" in s for s in stale)
+
+
+def test_effective_outcomes_fold_ancestors_and_wildcard():
+    tax = {
+        "errors": {
+            "ServeError": {"bases": []},
+            "ShedError": {"bases": ["ServeError"]},
+            "LaneError": {"bases": ["ShedError"]},
+        },
+        "edges": [
+            {"error": "ShedError", "outcome": "shed", "via": ["a"]},
+            {"error": "ServeError", "outcome": "expired", "via": ["b"]},
+            {"error": "*", "outcome": "failed", "via": ["c"]},
+        ],
+        "outcome_classes": list(OUTCOME_CLASSES),
+    }
+    eff = effective_outcomes(tax)
+    assert eff["LaneError"] == {"shed", "expired", "failed"}
+    assert eff["ShedError"] == {"shed", "expired", "failed"}
+    assert eff["ServeError"] == {"expired", "failed"}
+
+
+def test_fault_pass_needed_scoping():
+    assert fault_pass_needed(None) is True
+    assert fault_pass_needed(["esac_tpu/serve/dispatcher.py"]) is True
+    assert fault_pass_needed(["esac_tpu/fleet/router.py"]) is True
+    assert fault_pass_needed(["esac_tpu/lint/faultflow.py"]) is True
+    assert fault_pass_needed(["esac_tpu/geometry/pnp.py"]) is False
+    assert fault_pass_needed([]) is False
+
+
+# --------------------------------------------------------------------------
+# CLI end-to-end: the committed-artifact gate
+
+def test_cli_fault_taxonomy_gate(tmp_path, capsys):
+    """An audited tree whose fleet mints errors but has no committed
+    taxonomy fails typed (R16 missing-fault-taxonomy);
+    --write-fault-taxonomy + rerun is clean; a new error class then
+    fails as unreviewed with a stable json id."""
+    _write(tmp_path, "esac_tpu/lint/registry.py", "R11_WAIVED = {}\n")
+    _mint_tree(tmp_path)
+    assert lint_main(["--root", str(tmp_path), "--no-jaxpr",
+                      "--write-lock-graph"]) == 0
+    capsys.readouterr()
+
+    rc = lint_main(["--root", str(tmp_path), "--no-jaxpr"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "no committed fault taxonomy" in out
+
+    assert lint_main(["--root", str(tmp_path), "--no-jaxpr",
+                      "--write-fault-taxonomy"]) == 0
+    err = capsys.readouterr().err
+    assert "error class(es)" in err
+    assert lint_main(["--root", str(tmp_path), "--no-jaxpr"]) == 0
+    capsys.readouterr()
+
+    _write(tmp_path, "esac_tpu/serve/growth.py", """\
+        from esac_tpu.serve.slo import ServeError
+
+        class BrandNewError(ServeError):
+            retryable = False
+            wire_name = "brand_new"
+
+        def submit(req):
+            raise BrandNewError("x")
+        """)
+    rc = lint_main(["--root", str(tmp_path), "--no-jaxpr",
+                    "--format", "json"])
+    captured = capsys.readouterr()
+    assert rc == 1
+    objs = [json.loads(line) for line in captured.out.strip().splitlines()]
+    gate = [o for o in objs if o["text"] == "error:BrandNewError"]
+    assert len(gate) == 1
+    assert gate[0]["rule"] == "R16"
+    assert gate[0]["id"].startswith("R16-")
+
+
+def test_cli_changed_mode_skips_pass_unless_fleet_file_changed(tmp_path):
+    """run_faultflow_rules honours the lock-pass scoping contract: a
+    geometry-only scoped run never analyzes (satellite: --changed stays
+    fast), a fleet-scoped run does."""
+    _base_tree(tmp_path)
+    _write(tmp_path, "esac_tpu/serve/bad.py", """\
+        def submit(req):
+            raise ValueError("boom")
+        """)
+    assert run_faultflow_rules(
+        tmp_path, files=["esac_tpu/geometry/pnp.py"]) == []
+    assert _texts(run_faultflow_rules(
+        tmp_path, files=["esac_tpu/serve/bad.py"]), "R16") != []
+
+
+# --------------------------------------------------------------------------
+# the runtime outcome witness
+
+_WTAX = {
+    "errors": {
+        "ServeError": {"bases": []},
+        "ShedError": {"bases": ["ServeError"]},
+        "DeadlineExceededError": {"bases": ["ServeError"]},
+    },
+    "edges": [
+        {"error": "ShedError", "outcome": "shed", "via": ["a"]},
+        {"error": "DeadlineExceededError", "outcome": "expired",
+         "via": ["b"]},
+    ],
+    "outcome_classes": list(OUTCOME_CLASSES),
+}
+
+
+def test_outcome_witness_accepts_committed_flows():
+    w = OutcomeWitness(_WTAX)
+    w.observe("ShedError", "shed")
+    w.observe("DeadlineExceededError", "expired")
+    w.observe(None, "served")
+    assert w.violations() == []
+    w.assert_consistent()
+    snap = w.snapshot()
+    assert snap["observed"] == {"ShedError->shed": 1,
+                                "DeadlineExceededError->expired": 1}
+    assert snap["error_free_outcomes"] == {"served": 1}
+    assert snap["committed_errors"] == 3
+
+
+def test_outcome_witness_catches_off_taxonomy_flows():
+    w = OutcomeWitness(_WTAX)
+    w.observe("MadeUpError", "failed")          # not a member
+    w.observe("ShedError", "degraded")          # off-edge pair
+    w.observe(None, "lost")                     # off-vocabulary outcome
+    v = w.violations()
+    assert len(v) == 3
+    assert any("MadeUpError" in s and "not a member" in s for s in v)
+    assert any("ShedError->degraded" in s for s in v)
+    assert any("lost" in s for s in v)
+    with pytest.raises(AssertionError, match="escapes the committed"):
+        w.assert_consistent()
+
+
+def test_outcome_witness_wildcard_and_inheritance():
+    tax = json.loads(json.dumps(_WTAX))
+    tax["edges"].append({"error": "*", "outcome": "failed", "via": ["c"]})
+    w = OutcomeWitness(tax)
+    w.observe("ServeError", "failed")     # wildcard backstop
+    w.observe("ShedError", "failed")      # wildcard folds into members
+    assert w.violations() == []
+    # Inheritance: a subclass rides its ancestors' committed edges.
+    tax["errors"]["LaneError"] = {"bases": ["ShedError"]}
+    w2 = OutcomeWitness(tax)
+    w2.observe("LaneError", "shed")
+    assert w2.violations() == []
+
+
+def test_outcome_witness_observe_run_and_bind_obs():
+    from esac_tpu.obs.metrics import MetricsRegistry
+
+    w = OutcomeWitness(_WTAX)
+    w.observe_run({
+        "per_request_outcomes": ["served", "shed", "expired"],
+        "per_request_error_types": [None, "ShedError",
+                                    "DeadlineExceededError"],
+    })
+    assert w.violations() == []
+    assert w.pairs() == {("ShedError", "shed"): 1,
+                         ("DeadlineExceededError", "expired"): 1}
+    reg = MetricsRegistry()
+    w.bind_obs(reg)
+    snap = reg.snapshot()
+    assert snap["collectors"]["fault_taxonomy"]["violations"] == []
+
+
+def test_outcome_witness_from_repo_reads_the_committed_artifact():
+    w = OutcomeWitness.from_repo(REPO)
+    w.observe("DeadlineExceededError", "expired")
+    w.observe("ManifestError", "failed")  # via the committed backstop
+    assert w.violations() == []
+    with pytest.raises(FileNotFoundError):
+        OutcomeWitness.from_repo(REPO / "tests")
+
+
+# --------------------------------------------------------------------------
+# repo pins: the REAL committed taxonomy, member by member
+
+def test_repo_taxonomy_members_and_contracts():
+    """The committed catalog is load-bearing API: every member carries
+    an explicit retryable flag and a unique wire name, and the members
+    the fleet's callers branch on are pinned here by name."""
+    tax = load_taxonomy(REPO / FAULT_TAXONOMY_NAME)
+    assert tax is not None
+    errors = tax["errors"]
+    for name in ("ServeError", "ShedError", "DeadlineExceededError",
+                 "DispatchStalledError", "WorkerDiedError",
+                 "DispatcherClosedError", "LaneQuarantinedError",
+                 "ConfigError", "ManifestError", "SceneLoadError",
+                 "ChecksumMismatchError", "SceneUnhealthyError",
+                 "ReplicaQuarantinedError"):
+        assert name in errors, name
+        assert isinstance(errors[name]["retryable"], bool), name
+        assert isinstance(errors[name]["wire_name"], str), name
+    wires = [e["wire_name"] for e in errors.values()]
+    assert len(wires) == len(set(wires))
+    # The retryability split the failover/breaker paths rely on.
+    assert errors["ShedError"]["retryable"] is True
+    assert errors["DeadlineExceededError"]["retryable"] is True
+    assert errors["ConfigError"]["retryable"] is False
+    assert errors["ReplicaQuarantinedError"]["retryable"] is False
+    assert errors["ChecksumMismatchError"]["retryable"] is False
+
+
+def test_repo_taxonomy_edges_pinned():
+    """The accounted disposal map: the edges the chaos/fleet drills
+    exercise, plus the broad backstop that makes the outcome gate
+    total."""
+    tax = load_taxonomy(REPO / FAULT_TAXONOMY_NAME)
+    edges = {(e["error"], e["outcome"]) for e in tax["edges"]}
+    assert ("DeadlineExceededError", "expired") in edges
+    assert ("ShedError", "shed") in edges
+    assert ("LaneQuarantinedError", "shed") in edges
+    assert ("*", "failed") in edges
+    eff = effective_outcomes(tax)
+    # Every committed member disposes SOMEWHERE (the exhaustiveness
+    # gate the static pass enforces, re-asserted on the artifact).
+    for name, outs in eff.items():
+        assert outs, f"{name} has no effective outcome"
+        assert outs <= set(tax["outcome_classes"]), name
+
+
+def test_repo_matches_runtime_contract():
+    """The committed retryable/wire_name literals equal the live class
+    attributes — the artifact IS the wire contract, not a copy that can
+    drift."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import esac_tpu.fleet.router as router
+    import esac_tpu.registry.health as health
+    import esac_tpu.registry.manifest as manifest
+    import esac_tpu.serve.slo as slo
+
+    tax = load_taxonomy(REPO / FAULT_TAXONOMY_NAME)
+    for name, rec in tax["errors"].items():
+        cls = getattr(slo, name, None) or getattr(manifest, name, None) \
+            or getattr(health, name, None) or getattr(router, name, None)
+        assert cls is not None, name
+        assert cls.retryable is rec["retryable"], name
+        assert cls.wire_name == rec["wire_name"], name
+
+
+# --------------------------------------------------------------------------
+# regression tests for the v5 full-tree triage fixes (satellite 1: every
+# real fix the first clean sweep forced gets pinned here)
+
+def _cpu():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def test_triage_config_error_contract_and_conversions():
+    """API-misuse raises outside constructors now mint ConfigError — a
+    ServeError taxonomy member that KEEPS the ValueError MRO, so every
+    pre-v5 `except ValueError` caller still works."""
+    _cpu()
+    from esac_tpu.serve import pick_bucket
+    from esac_tpu.serve.loadgen import poisson_arrivals, uniform_arrivals
+    from esac_tpu.serve.slo import ConfigError, ServeError
+
+    assert issubclass(ConfigError, ServeError)
+    assert issubclass(ConfigError, ValueError)
+    assert ConfigError.retryable is False
+    assert ConfigError.wire_name == "config"
+    with pytest.raises(ConfigError):
+        pick_bucket(17, (1, 4, 16))
+    with pytest.raises(ValueError):  # the back-compat contract
+        pick_bucket(0, (1, 4))
+    with pytest.raises(ConfigError):
+        poisson_arrivals(0.0, 4)
+    with pytest.raises(ConfigError):
+        uniform_arrivals(-1.0, 4)
+
+
+def test_triage_manifest_error_keeps_valueerror_compat():
+    """The serving-config raises converted to ManifestError stay
+    catchable as ValueError (ManifestError subclasses it)."""
+    _cpu()
+    from esac_tpu.registry.manifest import ManifestError
+
+    assert issubclass(ManifestError, ValueError)
+    assert ManifestError.retryable is False
+    assert ManifestError.wire_name == "manifest"
+
+
+def test_triage_rule_engine_counts_eval_errors():
+    """A sick health rule is counted, not hidden: the R17 fix gave the
+    broad rule-evaluation guard an eval_errors counter that rides the
+    engine snapshot."""
+    _cpu()
+    from esac_tpu.obs.rules import RuleEngine
+
+    class _Timeline:
+        ticks = 1
+
+        @staticmethod
+        def windows():
+            return [{"t": 0}]
+
+    class _SickRule:
+        name = "sick"
+
+        @staticmethod
+        def evaluate(windows):
+            raise RuntimeError("boom")
+
+    eng = RuleEngine(_Timeline(), [_SickRule()])
+    eng.evaluate()
+    eng.evaluate()
+    assert eng.snapshot()["eval_errors"] == 2
+
+
+def test_triage_prefetcher_counts_feed_errors():
+    """The prefetcher's never-raise arrival feed counts its swallowed
+    failures (R17 fix) and publishes them through stats()."""
+    _cpu()
+    from esac_tpu.registry.prefetch import WeightPrefetcher
+
+    ticks = [0]
+
+    def clock():
+        ticks[0] += 1
+        if ticks[0] > 1:  # construction reads the clock once
+            raise RuntimeError("clock down")
+        return 0.0
+
+    pf = WeightPrefetcher(registry=None, clock=clock)
+    pf.observe("s0")
+    pf.observe("s1")
+    stats = pf.stats()
+    assert stats["feed_errors"] == 2
+    assert pf.feed_errors == 2
+
+
+def test_triage_wedged_legacy_close_is_bounded(monkeypatch):
+    """The R18 fix: a legacy-mode close() with a worker wedged inside
+    the serve fn (the TPU-relay hazard) returns within the bounded
+    drain window, fails the undrained request typed, and abandons the
+    daemon thread instead of joining forever."""
+    _cpu()
+    import time as _time
+
+    import numpy as np
+
+    import esac_tpu.serve.dispatcher as dispatcher_mod
+    from esac_tpu.ransac import RansacConfig
+    from esac_tpu.serve import MicroBatchDispatcher
+    from esac_tpu.serve.slo import DispatcherClosedError
+
+    import threading
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    def wedge(tree, scene=None, route_k=None):
+        entered.set()
+        release.wait(30.0)
+        return {"echo": tree["x"]}
+
+    monkeypatch.setattr(dispatcher_mod, "_LEGACY_DRAIN_JOIN_S", 0.5)
+    cfg = RansacConfig(n_hyps=8, frame_buckets=(1, 4),
+                       serve_max_wait_ms=0.0)
+    disp = MicroBatchDispatcher(wedge, cfg)
+    try:
+        disp.submit({"x": np.zeros(2, np.float32)})
+        assert entered.wait(10.0)
+        r2 = disp.submit({"x": np.ones(2, np.float32)})
+        t0 = _time.perf_counter()
+        disp.close()
+        assert _time.perf_counter() - t0 < 10.0
+        assert r2.done
+        assert r2.outcome == "failed"
+        assert isinstance(r2.error, DispatcherClosedError)
+    finally:
+        release.set()
+
+
+def test_triage_fleet_close_join_is_bounded():
+    """FleetRouter.close joins its poll thread with a timeout (R18) —
+    the constant exists and a normal close returns promptly."""
+    _cpu()
+    import esac_tpu.fleet.router as router_mod
+
+    assert 0 < router_mod._CLOSE_JOIN_S < 60
+
+
+def test_triage_release_replica_unknown_name_is_typed():
+    """fleet.release_replica on an unknown replica mints ConfigError
+    (was a bare ValueError) — and ConfigError is importable where it is
+    raised."""
+    _cpu()
+    from esac_tpu.fleet import FleetPolicy, FleetRouter, Replica
+    from esac_tpu.obs import MetricsRegistry  # noqa: F401 — cpu guard
+    from esac_tpu.ransac import RansacConfig
+    from esac_tpu.serve import MicroBatchDispatcher
+    from esac_tpu.serve.slo import ConfigError
+
+    import numpy as np
+
+    def echo(tree, scene=None, route_k=None):
+        return {"echo": tree["x"]}
+
+    cfg = RansacConfig(n_hyps=8, frame_buckets=(1, 4))
+    disp = MicroBatchDispatcher(echo, cfg, start_worker=False)
+    router = FleetRouter([Replica("r0", disp)], FleetPolicy(poll_ms=5.0),
+                         start=False)
+    try:
+        with pytest.raises(ConfigError):
+            router.release_replica("nope")
+        with pytest.raises(ValueError):  # back-compat MRO
+            router.release_replica("nope")
+    finally:
+        router.close(close_replicas=True)

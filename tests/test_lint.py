@@ -1322,12 +1322,18 @@ def test_lint_wall_clock_recorded_and_inside_budget(traced_registry):
         load_graph,
     )
 
+    from esac_tpu.lint import faultflow
+
     _, trace_s = traced_registry
     t0 = time.perf_counter()
     run_layer1(REPO)
     committed = load_graph(REPO / LOCK_GRAPH_NAME)
     if committed is not None:
         diff_graph(committed, build_graph(REPO))
+    committed_tax = faultflow.load_taxonomy(
+        REPO / faultflow.FAULT_TAXONOMY_NAME)
+    if committed_tax is not None:
+        faultflow.diff_taxonomy(committed_tax, faultflow.build_taxonomy(REPO))
     layer1_s = time.perf_counter() - t0
     total = trace_s + layer1_s
     wall_file = REPO / ".tier1_wall.json"
@@ -1385,6 +1391,37 @@ def test_changed_mode_lock_pass_rides_fleet_and_lint_edits():
     assert not lock_pass_needed(
         ["esac_tpu/utils/num.py", "bench.py", "DESIGN.md"]
     )
+
+
+# --------------------------------------------------------------------------
+# graft-audit v5: the committed fault-taxonomy gate (tests/
+# test_faultflow.py carries the fixture-level R16/R17/R18 and outcome-
+# witness coverage plus the member-by-member repo pins)
+
+def test_committed_fault_taxonomy_matches_tree_exactly():
+    """The tier-1 fault-taxonomy gate, ledger-style: the committed
+    .fault_taxonomy.json equals the recomputed fleet fault-flow
+    analysis exactly — any drift means regenerate-and-review
+    (--write-fault-taxonomy), any unreviewed new error class or
+    raise->outcome edge means exit 1 (R16 diff gate)."""
+    from esac_tpu.lint.faultflow import (
+        FAULT_TAXONOMY_NAME,
+        build_taxonomy,
+        diff_taxonomy,
+        load_taxonomy,
+    )
+
+    current = build_taxonomy(REPO)
+    committed = load_taxonomy(REPO / FAULT_TAXONOMY_NAME)
+    assert committed is not None, \
+        "no committed fault taxonomy: run `python -m esac_tpu.lint " \
+        "--write-fault-taxonomy` and review the catalog"
+    findings, stale = diff_taxonomy(committed, current)
+    assert findings == [], "\n".join(f.format() for f in findings)
+    assert stale == [], "\n".join(stale)
+    assert committed == json.loads(json.dumps(current)), \
+        "fault taxonomy drift: regenerate with --write-fault-taxonomy " \
+        "and review"
 
 
 # --------------------------------------------------------------------------

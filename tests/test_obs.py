@@ -992,7 +992,9 @@ def test_every_registered_collector_is_known_and_renders():
     covered by export.KNOWN_COLLECTORS (a NEW collector cannot land
     unrendered — adding it forces a reviewed entry here), and every
     pinned numeric field renders as a real Prometheus sample."""
-    from esac_tpu.lint.witness import LockWitness
+    import pathlib
+
+    from esac_tpu.lint.witness import LockWitness, OutcomeWitness
     from esac_tpu.obs.export import KNOWN_COLLECTORS
     from esac_tpu.registry import SceneManifest, SceneRegistry
     from esac_tpu.fleet import FleetPolicy, FleetRouter, Replica
@@ -1006,6 +1008,8 @@ def test_every_registered_collector_is_known_and_renders():
 
         HostWeightTier(compression="none").bind_obs(disp.obs)
     LockWitness().bind_obs(disp.obs)
+    OutcomeWitness.from_repo(
+        pathlib.Path(__file__).resolve().parents[1]).bind_obs(disp.obs)
     disp.obs.trace_store()
     disp.obs.attach_health_rules()
     router = FleetRouter(
