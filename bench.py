@@ -143,6 +143,13 @@ _CHAOS_FILE = _REPO / ".chaos_drill.json"
 _OBS_FILE = _REPO / ".obs_overhead.json"
 _PREFETCH_FILE = _REPO / ".weight_tiers.json"
 _FLEET_FILE = _REPO / ".fleet_serve.json"
+_HOSTPATH_FILE = _REPO / ".hostpath.json"
+
+# ISSUE 17 committed baseline: .fleet_serve.json's per_replica_capacity_rps
+# as measured BEFORE the host hot-path overhaul (the number the >= 1.3x
+# capacity gate is judged against — same operating point, same protocol).
+HOSTPATH_BASELINE_RPS = 629.94
+HOSTPATH_REQUESTS = 300  # traced closed-loop requests for the stage table
 
 
 def _measure_jax(
@@ -1679,11 +1686,15 @@ def _measure_fleet(seconds: float = FLEET_SECONDS) -> dict:
     try:
         return _measure_fleet_at(root, seconds)
     finally:
+        import gc
+
+        gc.unfreeze()  # no-op on clean exit; exception-path safety net
         shutil.rmtree(root, ignore_errors=True)
 
 
 def _measure_fleet_at(root: pathlib.Path, seconds: float) -> dict:
     import collections
+    import gc
     import threading
 
     import jax
@@ -1804,6 +1815,15 @@ def _measure_fleet_at(root: pathlib.Path, seconds: float) -> dict:
                     retry_max=1, quarantine_after=2)
     for rep in replicas:
         rep.dispatcher._slo = slo  # sized from the measured dispatch
+
+    # ISSUE 17 satellite: the fixture is fully prewarmed — weights
+    # loaded, programs compiled, dispatchers built — so freeze that
+    # long-lived heap out of the collector's sight for the measured
+    # legs (a mid-leg gen-2 pass re-scanning it reads as a ~100ms
+    # server stall in the tail).  Provenance rides the artifact.
+    gc.collect()
+    gc.freeze()
+    gc_before = gc.get_stats()
 
     # graft-audit v3 runtime lock witness over the WHOLE fleet —
     # attached before any worker/router thread starts (the witness
@@ -2072,6 +2092,15 @@ def _measure_fleet_at(root: pathlib.Path, seconds: float) -> dict:
     # the wedge window's failovers) rode committed taxonomy edges.
     outcome_witness.assert_consistent()
 
+    gc_block = {
+        "frozen": True,
+        "collections_during_run": [
+            int(a["collections"] - b["collections"])
+            for a, b in zip(gc.get_stats(), gc_before)
+        ],
+    }
+    gc.unfreeze()
+
     return {
         "replicas": FLEET_REPLICAS,
         "scenes": {"n": FLEET_SCENES, "hw": [H, W], "num_experts": M,
@@ -2111,6 +2140,7 @@ def _measure_fleet_at(root: pathlib.Path, seconds: float) -> dict:
             ),
         },
         "fault_taxonomy": outcome_witness.snapshot(),
+        "gc": gc_block,
         "obs_snapshot": obs_snapshot,
         "note": (
             "open-loop Zipf scene trace over a scene-affinity replica "
@@ -2130,6 +2160,51 @@ def _measure_fleet_at(root: pathlib.Path, seconds: float) -> dict:
             "one core/chip per replica (PARALLELISM.md)"
         ),
     }
+
+
+def _measure_hostpath(n_requests: int = HOSTPATH_REQUESTS) -> dict:
+    """Host hot-path evidence leg (ISSUE 17, DESIGN.md §21): the
+    stage-attributed host-overhead breakdown plus the before/after
+    per-replica capacity verdict, riding tools/hostpath_profile.py (the
+    same measurement committed as the overhaul's before/after evidence).
+
+    Two numbers matter:
+
+    - **stage table / host share**: where each traced request's wall goes
+      across admitted -> coalesced -> staged -> dispatched -> device ->
+      sliced -> outcome (span-trace stamps, zero new instrumentation);
+    - **capacity gate**: closed-loop per-replica capacity at the fleet
+      bench's exact operating point vs the committed pre-overhaul
+      baseline (``HOSTPATH_BASELINE_RPS``) — the ISSUE 17 acceptance
+      gate is >= 1.3x.  Cross-round CPU drift caveat applies (see the
+      contention block): the gate compares against a COMMITTED number,
+      so judge it together with the artifact's recorded stage shares.
+
+    CPU-forced inside the profiler (host cost is the measurand; the
+    relay is never touched), with gc frozen over both measured windows
+    and the accounting invariant checked over the traced run.
+    """
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "hostpath_profile", _REPO / "tools" / "hostpath_profile.py")
+    prof = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(prof)
+
+    out = prof.profile(n_requests=n_requests)
+    after = out["capacity"]["per_replica_capacity_rps"]
+    out["capacity"] = {
+        **out["capacity"],
+        "committed_baseline_rps": HOSTPATH_BASELINE_RPS,
+        "speedup_x_vs_committed": round(after / HOSTPATH_BASELINE_RPS, 3),
+        "gate_1p3x": bool(after >= 1.3 * HOSTPATH_BASELINE_RPS),
+    }
+    t = out["accounting"]
+    out["accounting_exact"] = bool(
+        sum(t[o] for o in ("served", "shed", "expired", "degraded",
+                           "failed")) + t["pending"] == t["offered"]
+    )
+    return out
 
 
 def _measure_obs(
@@ -2639,6 +2714,8 @@ def device_child(kwargs: dict) -> None:
         payload = {"prefetch": _measure_prefetch(**kwargs)}
     elif kwargs.pop("fleet", False):
         payload = {"fleet": _measure_fleet(**kwargs)}
+    elif kwargs.pop("hostpath", False):
+        payload = {"hostpath": _measure_hostpath(**kwargs)}
     else:
         payload = {"rate": _measure_jax(**kwargs)}
     import jax
@@ -3264,6 +3341,30 @@ def _obs_main(stopped: list[int], load_before: list[float]) -> None:
                  artifact_path=_OBS_FILE, headline=_obs_headline)
 
 
+def _hostpath_headline(hostpath: dict) -> dict:
+    cap = hostpath["capacity"]
+    return {
+        "metric": "hostpath_per_replica_capacity_rps",
+        "value": cap["per_replica_capacity_rps"],
+        "unit": "rps",
+        "vs_baseline": cap["speedup_x_vs_committed"],
+        "gate_1p3x_vs_committed": cap["gate_1p3x"],
+        "host_share": hostpath["host_overhead"]["host_share"],
+        "hot_path_recompiles":
+            hostpath["compiled_programs"]["hot_path_recompiles"],
+        "accounting_exact": hostpath["accounting_exact"],
+    }
+
+
+def _hostpath_main(stopped: list[int], load_before: list[float]) -> None:
+    """``python bench.py hostpath`` — the ISSUE 17 host hot-path stage
+    breakdown + capacity gate (DESIGN.md §21) through the shared
+    wedge-safe scaffold (.hostpath.json)."""
+    _driver_main(stopped, load_before, key="hostpath", what="hostpath profile",
+                 measure_cpu=lambda: _measure_hostpath(),
+                 artifact_path=_HOSTPATH_FILE, headline=_hostpath_headline)
+
+
 def _main_measured(stopped: list[int], load_before: list[float]) -> None:
     modes = {
         "serve": _serve_main,
@@ -3275,6 +3376,7 @@ def _main_measured(stopped: list[int], load_before: list[float]) -> None:
         "obs": _obs_main,
         "prefetch": _prefetch_main,
         "fleet": _fleet_main,
+        "hostpath": _hostpath_main,
     }
     if len(sys.argv) > 1 and sys.argv[1] in modes:
         modes[sys.argv[1]](stopped, load_before)

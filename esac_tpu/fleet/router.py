@@ -309,6 +309,11 @@ class FleetRouter:
         self._quarantined: dict[str, str] = {}
         self._fail_streak: collections.Counter = collections.Counter()
         self._scene_home: dict = {}
+        # Incremental mirror of "homes held per replica" (the tie-break
+        # _route_locked orders by): maintained by _claim_home_locked so
+        # the per-request routing pass stops rebuilding a Counter over
+        # the whole affinity table (the host-path overhaul).
+        self._homes_held: collections.Counter = collections.Counter()
         self._load: collections.Counter = collections.Counter()
         self._recent_scenes: collections.deque = collections.deque(
             maxlen=policy.arrivals_window
@@ -438,6 +443,8 @@ class FleetRouter:
         deadline = (t_submit + deadline_ms / 1e3
                     if deadline_ms is not None else None)
         req = FleetRequest(frame, scene, route_k, deadline, t_submit, self)
+        route = None
+        route_err = None
         with self._lock:
             if self._closed:
                 raise DispatcherClosedError("fleet router is closed")
@@ -459,8 +466,25 @@ class FleetRouter:
                 # fsum equals the end-to-end span EXACTLY — the §14
                 # telescoping invariant at fleet scope (bench-pinned).
                 req.trace = Trace(t_submit, scene=scene, sampled_1_in=n)
+            # First route decision in the SAME critical section as the
+            # books (the host-path overhaul: one lock pass per request
+            # on the happy path, not one for books plus one to route).
+            # A dead-on-arrival deadline skips it — _dispatch_to_replica
+            # expires the request before any placement side effect (a
+            # cold route claims a home), exactly as the two-pass path
+            # did.  A routing shed is classified here, not re-raised
+            # through the handlers below, because the lock must be
+            # released between the decision and the finish.
+            if deadline is None or deadline > t_submit:
+                try:
+                    route = self._route_locked(scene, set(), None)
+                except ShedError as e:  # incl. ReplicaQuarantinedError
+                    route_err = e
+                    self._finish_locked(req, error=e, outcome="shed")
+        if route_err is not None:
+            raise route_err
         try:
-            self._dispatch_to_replica(req, exclude=set())
+            self._dispatch_to_replica(req, exclude=set(), route=route)
         except DeadlineExceededError as e:
             with self._lock:
                 self._finish_locked(req, error=e, outcome="expired")
@@ -501,12 +525,16 @@ class FleetRouter:
             limit = grace if limit is None else min(limit, grace)
         return req.get(limit)
 
-    def _dispatch_to_replica(self, req: FleetRequest, exclude: set) -> None:
+    def _dispatch_to_replica(self, req: FleetRequest, exclude: set,
+                             route=None) -> None:
         """Admit ``req`` to a replica chosen by the affinity table
         (NO router lock held across the dispatcher submit — R13).
         Spills walk the healthy set; a replica whose dispatcher is
         closed/dead is noted as a replica fault and skipped.  Raises
-        the last typed rejection when nobody could take it."""
+        the last typed rejection when nobody could take it.  ``route``
+        is an optional pre-made first (name, kind) decision — submit
+        routes inside its books critical section — consumed on the
+        first attempt only; every retry re-decides under the lock."""
         exclude = set(exclude)
         last_shed = None
         while True:
@@ -517,9 +545,13 @@ class FleetRouter:
                     f"(scene {req.scene!r}, "
                     f"{len(exclude)} replica(s) already tried)"
                 )
-            with self._lock:
-                name, kind = self._route_locked(req.scene, exclude,
-                                                last_shed)
+            if route is not None:
+                name, kind = route
+                route = None
+            else:
+                with self._lock:
+                    name, kind = self._route_locked(req.scene, exclude,
+                                                    last_shed)
             rep = self._replicas[name]
             remaining_ms = (None if req.deadline is None
                             else (req.deadline - now) * 1e3)
@@ -612,10 +644,10 @@ class FleetRouter:
         # in-flight load falls back to fewest homes held, so cold
         # scenes SPREAD across an idle fleet instead of all claiming
         # the first replica — the scene-sharded placement the affinity
-        # table then preserves.
-        homes_held = collections.Counter(
-            n for h in self._scene_home.values() for n in h
-        )
+        # table then preserves.  (_homes_held is the incrementally
+        # maintained count — this used to be a full rebuild over the
+        # affinity table on EVERY route decision.)
+        homes_held = self._homes_held
         order = {n: (self._load[n], homes_held[n], n) for n in avail}
         if scene is None:
             return min(avail, key=order.__getitem__), "dense"
@@ -642,10 +674,12 @@ class FleetRouter:
         if name in homes:
             return
         homes.append(name)
+        self._homes_held[name] += 1
         while len(homes) > self._policy.max_homes_per_scene:
             dead = next((h for h in homes if h in self._quarantined),
                         homes[0])
             homes.remove(dead)
+            self._homes_held[dead] -= 1
 
     def _abandon(self, req: FleetRequest, err) -> None:
         """Caller-side timeout (FleetRequest.get): record the fleet
@@ -664,9 +698,15 @@ class FleetRouter:
             ureq.owner._abandon(ureq, err)
 
     def _finish_locked(self, req: FleetRequest, result=None, error=None,
-                       outcome: str = "served") -> None:
+                       outcome: str = "served",
+                       publish: bool = True) -> None:
         """Resolve one fleet request exactly once (lock held): outcome
-        books + latency/failover histograms + event, one choke point."""
+        books + latency/failover histograms + event, one choke point.
+        ``publish=False`` defers the obs counter/histogram publishes to
+        the caller — the batched completion pass — which MUST publish
+        the aggregates for every such finish before releasing the lock;
+        the legacy books, pending pop, trace finish and event always
+        happen here."""
         if req.done:
             return
         req.done = True
@@ -675,10 +715,11 @@ class FleetRouter:
         req.outcome = outcome
         req.t_done = self._clock()
         self.outcome_counts[outcome] += 1
-        self._m_outcomes.inc(outcome=outcome)
+        if publish:
+            self._m_outcomes.inc(outcome=outcome)
         if req._key is not None:
             self._pending.pop(req._key, None)
-        if outcome in ("served", "degraded"):
+        if publish and outcome in ("served", "degraded"):
             self._m_latency.observe(req.t_done - req.t_submit)
             if req.t_faulted is not None:
                 self._m_failover_s.observe(req.t_done - req.t_faulted)
@@ -698,14 +739,8 @@ class FleetRouter:
         poll = self._policy.poll_ms / 1e3
         next_rebalance = self._clock() + self._policy.rebalance_every_s
         while True:
-            with self._lock:
-                if self._closed and not self._pending:
-                    return
-                ready = [r for r in self._pending.values()
-                         if not r.done and r.ureq is not None
-                         and r.ureq.event.is_set()]
-            for req in ready:
-                self._settle(req)
+            if self._settle():
+                return
             now = self._clock()
             if now >= next_rebalance:
                 self._rebalance()
@@ -723,59 +758,96 @@ class FleetRouter:
                     eng.maybe_evaluate()
             time.sleep(poll)
 
-    def _settle(self, req: FleetRequest) -> None:
-        """Consume one resolved underlying request: fulfill, classify,
-        or fail over.  The ureq is detached under the lock, so a second
-        pass (or a racing abandon) can never settle it twice."""
+    def _settle(self) -> bool:
+        """One BATCHED completion pass: scan for resolved underlying
+        requests and consume every one of them — fulfill, classify, or
+        queue for failover — in a SINGLE critical section (the host-path
+        overhaul: one lock acquisition per poll tick, not one for the
+        scan plus one per ready request), with the obs publishes
+        aggregated per outcome class at the end of the section.  Each
+        ureq is detached under the lock, so a racing abandon can never
+        settle it twice.  Fault follow-up — breaker bookkeeping and the
+        failover re-dispatch, both potentially blocking — runs OUTSIDE
+        the lock (R13), exactly as the per-request path did.  Returns
+        True when the router is closed and fully drained (the poll
+        loop's exit test, folded into the same acquisition)."""
+        n_by_outcome: collections.Counter = collections.Counter()
+        lats: list[float] = []
+        fo_lats: list[float] = []
+        faults = []
         with self._lock:
-            if req.done:
-                return
-            ureq = req.ureq
-            if ureq is None or not ureq.event.is_set():
-                return
-            req.ureq = None
-            self._load[req.replica] -= 1
-            if req.trace is not None:
-                # Child dispatch span: the underlying request's chain
-                # (ITS clock domain — it telescopes on its own) under
-                # the fleet root; failover siblings link via retry_of.
-                sp = req.trace.add_span(
-                    f"replica:{req.replica}", "dispatch",
-                    ureq.t_submit, ureq.t_done,
-                    stages=(ureq.spans.segments()
-                            if ureq.spans is not None else None),
-                    replica=req.replica, outcome=ureq.outcome,
-                    retry_of=(req._last_span.span_id
-                              if req._last_span is not None else None),
-                )
-                req._last_span = sp
-                # Root boundary (router clock): the replica segment ends
-                # when the completion loop CONSUMED it — poll latency is
-                # router overhead charged to the replica segment
-                # honestly, not hidden.
-                req.trace.stamp("replica", self._clock())
-            err = ureq.error
-            if err is None:
-                self._fail_streak.pop(req.replica, None)
-                self._finish_locked(req, result=ureq.result,
-                                    outcome=ureq.outcome)
-                return
-            if not isinstance(err, _REPLICA_FAULTS):
-                if isinstance(err, DeadlineExceededError):
-                    self._finish_locked(req, error=err, outcome="expired")
+            if self._closed and not self._pending:
+                return True
+            ready = [r for r in self._pending.values()
+                     if not r.done and r.ureq is not None
+                     and r.ureq.event.is_set()]
+            for req in ready:
+                ureq = req.ureq
+                req.ureq = None
+                self._load[req.replica] -= 1
+                if req.trace is not None:
+                    # Child dispatch span: the underlying request's chain
+                    # (ITS clock domain — it telescopes on its own) under
+                    # the fleet root; failover siblings link via retry_of.
+                    sp = req.trace.add_span(
+                        f"replica:{req.replica}", "dispatch",
+                        ureq.t_submit, ureq.t_done,
+                        stages=(ureq.spans.segments()
+                                if ureq.spans is not None else None),
+                        replica=req.replica, outcome=ureq.outcome,
+                        retry_of=(req._last_span.span_id
+                                  if req._last_span is not None else None),
+                    )
+                    req._last_span = sp
+                    # Root boundary (router clock): the replica segment
+                    # ends when the completion loop CONSUMED it — poll
+                    # latency is router overhead charged to the replica
+                    # segment honestly, not hidden.
+                    req.trace.stamp("replica", self._clock())
+                err = ureq.error
+                if err is None:
+                    self._fail_streak.pop(req.replica, None)
+                    self._finish_locked(req, result=ureq.result,
+                                        outcome=ureq.outcome,
+                                        publish=False)
+                    n_by_outcome[req.outcome] += 1
+                    lats.append(req.t_done - req.t_submit)
+                    if req.t_faulted is not None:
+                        fo_lats.append(req.t_done - req.t_faulted)
+                elif not isinstance(err, _REPLICA_FAULTS):
+                    if isinstance(err, DeadlineExceededError):
+                        self._finish_locked(req, error=err,
+                                            outcome="expired",
+                                            publish=False)
+                    else:
+                        # Scene-/request-level typed fault: every replica
+                        # would re-pay it — fail fast, don't fail over.
+                        self._finish_locked(req, error=err,
+                                            outcome="failed",
+                                            publish=False)
+                    n_by_outcome[req.outcome] += 1
                 else:
-                    # Scene-/request-level typed fault: every replica
-                    # would re-pay it — fail fast, don't fail over.
-                    self._finish_locked(req, error=err, outcome="failed")
-                return
-            faulted = req.replica
+                    faults.append((req, req.replica, err))
+            # Aggregated obs publish — still inside the critical
+            # section, so the counters and the done-flags/pending books
+            # move together (one truth), but with ONE instrument-lock
+            # acquisition per outcome class / histogram instead of one
+            # per request.
+            for o, n in n_by_outcome.items():
+                self._m_outcomes.inc(n, outcome=o)
+            if lats:
+                self._m_latency.observe_many(lats)
+            if fo_lats:
+                self._m_failover_s.observe_many(fo_lats)
         # Failover path, outside the lock: replica-INDICTING faults feed
         # the breaker first (it may quarantine and abandon the replica's
         # other in-flight work); lane/replica-quarantine drains skip it
         # (see _REPLICA_INDICTING) and only re-route.
-        if isinstance(err, _REPLICA_INDICTING):
-            self._note_replica_fault(faulted, err)
-        self._failover(req, faulted, err)
+        for req, faulted, err in faults:
+            if isinstance(err, _REPLICA_INDICTING):
+                self._note_replica_fault(faulted, err)
+            self._failover(req, faulted, err)
+        return False
 
     def _failover(self, req: FleetRequest, from_name: str, err) -> None:
         """Re-dispatch ``req`` to a surviving replica inside its

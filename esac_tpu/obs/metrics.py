@@ -151,6 +151,39 @@ class StreamingHistogram:
                     del self._counts[0]
                     del self._stats[0]
 
+    def observe_many(self, values) -> None:
+        """Bulk :meth:`observe`: ONE lock acquisition for a whole
+        dispatch's samples (the serving hot path publishes per-dispatch,
+        not per-request — the host-path overhaul's obs batching).
+        Sample-for-sample identical to a loop of scalar ``observe``
+        calls: same bucket increments, same lifetime stream, and the
+        epoch-rotation check runs after EVERY sample exactly as the
+        scalar path does, so windowed quantiles cannot tell the two
+        apart."""
+        vs = [float(v) for v in values]
+        if not vs:
+            return
+        with self._lock:
+            for v in vs:
+                counts, stats = self._counts[-1], self._stats[-1]
+                i = self._index(v)
+                counts[i] += 1
+                self._life_counts[i] += 1
+                self._life_n += 1
+                stats[0] += 1
+                if math.isfinite(v):
+                    stats[1] += v
+                    stats[2] = min(stats[2], v)
+                    stats[3] = max(stats[3], v)
+                    self._life_sum += v
+                if self._epoch_cap is not None \
+                        and stats[0] >= self._epoch_cap:
+                    self._counts.append(self._new_counts())
+                    self._stats.append([0, 0.0, math.inf, -math.inf])
+                    if len(self._counts) > self._epochs:
+                        del self._counts[0]
+                        del self._stats[0]
+
     def _merged_locked(self):
         """(counts, count, sum, min, max) over the retained window
         (lock held by the caller)."""
@@ -357,6 +390,12 @@ class HistogramVec:
 
     def observe(self, v: float, **labels) -> None:
         self._child(labels).observe(v)
+
+    def observe_many(self, values, **labels) -> None:
+        """Bulk observe into one child: a single family-lock lookup and
+        a single child-lock acquisition for the whole batch (vs one of
+        each per sample on the scalar path)."""
+        self._child(labels).observe_many(values)
 
     def labelsets(self) -> list[dict]:
         with self._lock:

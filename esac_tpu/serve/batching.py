@@ -29,6 +29,8 @@ Two invariants make padding safe:
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from esac_tpu.serve.slo import ConfigError
@@ -126,3 +128,153 @@ def pad_batch(batch: dict, bucket: int) -> tuple[dict, int]:
         raise ConfigError(f"{n_valid} frames do not fit bucket {bucket}")
     extra = lanes - n_valid
     return {k: _pad_leaf(v, extra) for k, v in batch.items()}, n_valid
+
+
+def _stage_leaf_slow(leaves: list, lanes: int):
+    """One leaf through the allocation path: exactly the
+    :func:`stack_frames` + :func:`_pad_leaf` composition (the staging
+    cache's bit-identity fallback for leaves its buffers cannot hold —
+    typed PRNG keys, mixed dtypes)."""
+    try:
+        x = np.stack([np.asarray(v) for v in leaves])
+    except (TypeError, ValueError):
+        import jax.numpy as jnp
+
+        x = jnp.stack(leaves)
+    return _pad_leaf(x, lanes - len(leaves))
+
+
+class _BufferPool:
+    """A fixed rotation of preallocated staging buffers (one shape/dtype)."""
+
+    __slots__ = ("bufs", "i")
+
+    def __init__(self, bufs: list[np.ndarray]):
+        self.bufs = bufs
+        self.i = 0
+
+    def take(self) -> np.ndarray:
+        buf = self.bufs[self.i]
+        self.i = (self.i + 1) % len(self.bufs)
+        return buf
+
+
+class StagingCache:
+    """Pooled staging: the zero-allocation fast path of
+    ``pad_batch(stack_frames(frames), bucket)``.
+
+    The dispatch hot path used to rebuild its padded host batch from
+    scratch every dispatch — per-leaf ``np.stack`` allocations plus a
+    ``np.concatenate`` for the pad tail.  This cache keeps per-thread
+    pools of preallocated ``(lanes, *frame_shape)`` numpy buffers keyed
+    by (leaf name, lanes, dtype, shape): staging becomes row copies into
+    an existing buffer and a broadcast fill of the pad tail.  Leaves the
+    buffers cannot hold bit-exactly — typed PRNG keys (not
+    numpy-convertible), a dtype/shape drift mid-stream (``np.stack``
+    would promote; a buffer write would silently cast) — fall back to
+    :func:`_stage_leaf_slow`, the verbatim old composition, per leaf per
+    call.  The result is bit-identical to ``pad_batch(stack_frames(..))``
+    in every case (pinned by tests/test_serve.py).
+
+    **Aliasing discipline** (why ``depth`` exists and must be >= 2): on
+    the CPU backend ``jax.device_put`` ZERO-COPIES — the device array
+    aliases the staging buffer — so a buffer may only be rewritten once
+    the dispatch that staged from it has completed.  Every dispatch path
+    runs ``block_until_ready`` before its thread stages again, and the
+    double-buffered ``infer_many`` overlaps at most ONE staging with one
+    in-flight dispatch, so a rotation of two buffers is exactly
+    sufficient: the buffer reused at dispatch N was staged at N-2, whose
+    compute the N-1 boundary already synced.  Pools are thread-local
+    (``threading.local``), which also isolates a watchdog-replaced
+    worker from a predecessor wedged mid-dispatch on the same lane — no
+    lock, no lock-graph node (R12), nothing shared to race (R10).
+
+    **R8 (donated buffers)**: these host templates never occupy a
+    donated position.  On accelerators ``device_put`` copies host->HBM,
+    so the donated operand is the device copy; on CPU the registry entry
+    points do not donate at all (donation is accelerator-only).  The
+    pooled buffer is therefore never the buffer XLA writes into.
+    """
+
+    def __init__(self, depth: int = 2):
+        if depth < 2:
+            raise ConfigError(
+                f"staging depth {depth} < 2: device_put may alias the "
+                "staging buffer (CPU zero-copy), so the buffer feeding an "
+                "in-flight dispatch must never be the next one rewritten"
+            )
+        self._depth = depth
+        self._tls = threading.local()
+
+    def stage(self, frames: list[dict], bucket: int) -> tuple[dict, int]:
+        """``pad_batch(stack_frames(frames), bucket)``, bit-identical,
+        through the per-thread buffer pools.  Returns (tree, n_valid).
+        The returned tree aliases pooled buffers: consume it (device_put)
+        before this thread stages ``depth`` more batches."""
+        n_valid = len(frames)
+        lanes = max(bucket, MIN_LANES)
+        if n_valid > bucket:
+            raise ConfigError(f"{n_valid} frames do not fit bucket {bucket}")
+        pools = getattr(self._tls, "pools", None)
+        if pools is None:
+            pools = self._tls.pools = {}
+        out = {}
+        for name in frames[0]:
+            leaves = [fr[name] for fr in frames]
+            buf = None
+            try:
+                row = np.asarray(leaves[0])
+            except (TypeError, ValueError):
+                row = None  # not numpy-convertible (typed PRNG keys)
+            if row is not None:
+                key = (name, lanes, row.dtype.str, row.shape)
+                pool = pools.get(key)
+                if pool is None:
+                    pool = pools[key] = _BufferPool([
+                        np.empty((lanes,) + row.shape, row.dtype)
+                        for _ in range(self._depth)
+                    ])
+                buf = pool.take()
+                buf[0] = row
+                for j in range(1, n_valid):
+                    try:
+                        a = np.asarray(leaves[j])
+                    except (TypeError, ValueError):
+                        buf = None
+                        break
+                    if a.dtype != buf.dtype or a.shape != buf.shape[1:]:
+                        buf = None  # np.stack would promote; don't cast
+                        break
+                    buf[j] = a
+            if buf is None:
+                out[name] = _stage_leaf_slow(leaves, lanes)
+            else:
+                if n_valid < lanes:
+                    buf[n_valid:] = buf[n_valid - 1]
+                out[name] = buf
+        return out, n_valid
+
+    def unalias(self, arrays: list) -> list:
+        """Copy every host result array that may alias one of this
+        thread's pooled staging buffers.
+
+        A compiled program that passes an input straight through to an
+        output (echo fields, request keys) returns an array that — via
+        the CPU zero-copy chain device_put -> execute -> np.asarray —
+        can BE the pooled buffer, and a result must stay valid after the
+        pool rewrites that buffer.  The old allocate-per-dispatch path
+        got this for free (inputs were fresh arrays nobody reused);
+        the dispatch paths call this on their host leaves to restore
+        exactly that guarantee.  ``may_share_memory`` is a cheap bounds
+        check; a false positive just buys one defensive copy."""
+        pools = getattr(self._tls, "pools", None)
+        if pools is None:
+            return list(arrays)
+        bufs = [b for p in pools.values() for b in p.bufs]
+        return [
+            a.copy()
+            if isinstance(a, np.ndarray)
+            and any(np.may_share_memory(a, b) for b in bufs)
+            else a
+            for a in arrays
+        ]

@@ -98,10 +98,9 @@ import time
 from esac_tpu.obs import MetricsRegistry, SpanChain, Trace, trace_scope
 from esac_tpu.ransac.config import RansacConfig
 from esac_tpu.serve.batching import (
-    pad_batch,
+    StagingCache,
     pick_bucket,
     plan_dispatches,
-    stack_frames,
 )
 from esac_tpu.serve.slo import (
     DeadlineExceededError,
@@ -223,6 +222,10 @@ class MicroBatchDispatcher:
         # zero cost beyond one attribute check.
         self._arrival_sink = arrival_sink
         self._buckets = tuple(sorted(set(cfg.frame_buckets)))
+        # Pooled host staging (per-thread buffers, see batching.py):
+        # padding templates are built once per (leaf, lanes, dtype,
+        # shape), not rebuilt every dispatch.
+        self._staging = StagingCache()
         self._max_wait_s = cfg.serve_max_wait_ms / 1e3
         self._depth = cfg.serve_queue_depth
         self._clock = clock
@@ -606,25 +609,29 @@ class MicroBatchDispatcher:
             lo += n
 
         def stage(lo, hi):
-            padded, n_valid = pad_batch(
-                stack_frames(frames[lo:hi]), pick_bucket(hi - lo, self._buckets)
-            )
-            return jax.device_put(padded), n_valid
+            bucket = pick_bucket(hi - lo, self._buckets)
+            padded, n_valid = self._staging.stage(frames[lo:hi], bucket)
+            return jax.device_put(padded), n_valid, bucket
 
         results: list[dict] = []
         staged = stage(*bounds[0])
         for i in range(len(bounds)):
-            tree, n_valid = staged
+            tree, n_valid, bucket = staged
             # async dispatch: compute starts
             out = self._call(tree, scene, route_k)
             if i + 1 < len(bounds):
                 staged = stage(*bounds[i + 1])  # host staging overlaps compute
             out = jax.block_until_ready(out)
             t_done = self._clock()
-            host = jax.tree.map(np.asarray, out)
+            # Flatten-once transfer + leaf-indexed slicing (same fast
+            # path as _dispatch).
+            leaves, treedef = jax.tree.flatten(out)
+            host_leaves = self._staging.unalias(
+                [np.asarray(x) for x in leaves]
+            )
             with self._lock:
                 self._record(
-                    pick_bucket(n_valid, self._buckets), n_valid, scene,
+                    bucket, n_valid, scene,
                     route_k, [t_done - t_submit] * n_valid,
                 )
                 self._count_offered(n_valid)
@@ -634,7 +641,8 @@ class MicroBatchDispatcher:
                 self._count_outcome("served", scene, route_k, route_k,
                                     n=n_valid)
             results.extend(
-                jax.tree.map(lambda x: x[j], host) for j in range(n_valid)
+                treedef.unflatten([hl[j] for hl in host_leaves])
+                for j in range(n_valid)
             )
         return results
 
@@ -695,17 +703,23 @@ class MicroBatchDispatcher:
         self.dispatch_counts[(scene, route_k)] += 1
         self.latencies_s.extend(latencies)
         self._m_dispatches.inc(scene=scene, route_k=route_k)
-        for lat in latencies:
-            self._m_latency.observe(lat)
-            self._m_lane_latency.observe(lat, scene=scene, route_k=route_k)
+        # Bulk publish: two histogram-lock acquisitions per DISPATCH
+        # (was two per lane-latency sample).
+        self._m_latency.observe_many(latencies)
+        self._m_lane_latency.observe_many(latencies, scene=scene,
+                                          route_k=route_k)
 
     def _finish(self, req: _Request, result=None, error=None,
-                outcome: str = "served", eff_k=None) -> bool:
+                outcome: str = "served", eff_k=None,
+                count: bool = True) -> bool:
         """Resolve one request exactly once (lock held).  Returns False if
         the request was already resolved — a late result from an abandoned
         (wedged, expired) dispatch is DISCARDED here, which is what makes
         watchdog/timeout abandonment safe against the worker eventually
-        unsticking."""
+        unsticking.  ``count=False`` defers the outcome accounting to the
+        caller, which MUST publish one aggregate ``_count_outcome`` for
+        every True return before releasing the lock (the batched
+        completion path in ``_run``)."""
         if req.done:
             return False
         req.done = True
@@ -713,7 +727,8 @@ class MicroBatchDispatcher:
         req.error = error
         req.outcome = outcome
         req.t_done = self._clock()
-        self._count_outcome(outcome, req.scene, req.route_k, eff_k)
+        if count:
+            self._count_outcome(outcome, req.scene, req.route_k, eff_k)
         if req.spans is not None:
             # Terminal stamp at t_done: the chain's total now telescopes
             # to the measured end-to-end latency, and each stage duration
@@ -916,15 +931,14 @@ class MicroBatchDispatcher:
                 else:
                     host, bucket, n_valid, t_done = self._dispatch(
                         reqs, scene, eff_k)
-                import jax
-
                 # Host-side result slicing: inside the try — a malformed
                 # result tree must fail THIS batch, never the worker — but
                 # OUTSIDE the lock: admission control's microsecond-
                 # rejection promise dies if submitters queue behind a
                 # full bucket's fan-out.
+                treedef, host_leaves = host
                 results = [
-                    jax.tree.map(lambda x, i=i: x[i], host)
+                    treedef.unflatten([hl[i] for hl in host_leaves])
                     for i in range(len(reqs))
                 ]
                 self._stamp(reqs, "sliced")
@@ -987,6 +1001,7 @@ class MicroBatchDispatcher:
                 self._record(bucket, n_valid, scene, route_k,
                              [t_done - r.t_submit for r in reqs])
                 outcome = "degraded" if degraded else "served"
+                n_ok = 0
                 for r, res in zip(reqs, results):
                     if r.deadline is not None and t_done > r.deadline:
                         # Landed past the deadline: the SLO contract says
@@ -1000,9 +1015,17 @@ class MicroBatchDispatcher:
                             ),
                             outcome="expired",
                         )
-                    else:
-                        self._finish(r, result=res, outcome=outcome,
-                                     eff_k=eff_k)
+                    elif self._finish(r, result=res, outcome=outcome,
+                                      eff_k=eff_k, count=False):
+                        n_ok += 1
+                if n_ok:
+                    # Batched outcome publish: every cleanly-served
+                    # request in this dispatch shares one outcome class,
+                    # so ONE counter/ring update covers them all — still
+                    # inside the same critical section as the _finish
+                    # calls, so accounting and done-flags move together.
+                    self._count_outcome(outcome, scene, route_k, eff_k,
+                                        n=n_ok)
             return
 
     def _dispatch(self, reqs: list[_Request], scene, route_k):
@@ -1016,8 +1039,8 @@ class MicroBatchDispatcher:
         import numpy as np
 
         bucket = pick_bucket(len(reqs), self._buckets)
-        padded, n_valid = pad_batch(
-            stack_frames([r.frame for r in reqs]), bucket
+        padded, n_valid = self._staging.stage(
+            [r.frame for r in reqs], bucket
         )
         staged = jax.device_put(padded)
         self._stamp(reqs, "staged")
@@ -1026,8 +1049,14 @@ class MicroBatchDispatcher:
         out = jax.block_until_ready(out)
         t_done = self._clock()
         self._stamp(reqs, "device", t_done)
-        host = jax.tree.map(np.asarray, out)
-        return host, bucket, n_valid, t_done
+        # Flatten ONCE for the whole batch: the device->host transfer is
+        # one np.asarray per leaf, and per-request slicing becomes a
+        # leaf-indexed unflatten (no per-request tree traversal).
+        leaves, treedef = jax.tree.flatten(out)
+        host_leaves = self._staging.unalias(
+            [np.asarray(x) for x in leaves]
+        )
+        return (treedef, host_leaves), bucket, n_valid, t_done
 
     # ---------------- watchdog ----------------
 

@@ -28,6 +28,7 @@ callback, which maps an arrival index to ``(frame, scene, route_k)``.
 
 from __future__ import annotations
 
+import gc
 import time
 
 import numpy as np
@@ -61,8 +62,20 @@ def run_open_loop(
     deadline_ms: float | None = None,
     hyps_per_request: int = 1,
     settle_s: float = 30.0,
+    freeze_gc: bool = True,
 ) -> dict:
     """Replay an open-loop arrival schedule against ``disp``.
+
+    Unless ``freeze_gc=False``, the run executes with the garbage
+    collector's existing heap FROZEN (``gc.collect()`` then
+    ``gc.freeze()``, unfrozen after): the PR-7 review measured gen-2
+    collection pauses as ~100 ms "server stalls" in the latency tail,
+    and every long-lived object at run start — compiled programs,
+    weight caches, the dispatcher itself — is prewarm state that a
+    mid-run gen-2 pass can only waste time re-scanning.  The summary's
+    ``gc`` block records the provenance (frozen flag + per-generation
+    collection counts during the run) so an artifact states the regime
+    its tail was measured under.
 
     ``make_request(i) -> (frame, scene, route_k)`` builds request ``i``;
     ``arrivals`` is the cumulative schedule (seconds from start).  Submits
@@ -97,9 +110,34 @@ def run_open_loop(
     over the served+degraded latencies, unchanged.
     """
     arrivals = np.asarray(arrivals, np.float64)
-    n = len(arrivals)
-    if n == 0:
+    if len(arrivals) == 0:
         raise ConfigError("empty arrival schedule")
+    frozen = False
+    if freeze_gc:
+        gc.collect()
+        gc.freeze()
+        frozen = True
+    gc_before = gc.get_stats()
+    try:
+        out = _run_paced(disp, make_request, arrivals, deadline_ms,
+                         hyps_per_request, settle_s)
+    finally:
+        if frozen:
+            gc.unfreeze()
+    out["gc"] = {
+        "frozen": frozen,
+        "collections_during_run": [
+            int(a["collections"] - b["collections"])
+            for a, b in zip(gc.get_stats(), gc_before)
+        ],
+    }
+    return out
+
+
+def _run_paced(disp, make_request, arrivals, deadline_ms,
+               hyps_per_request, settle_s) -> dict:
+    """The paced replay itself (see :func:`run_open_loop`)."""
+    n = len(arrivals)
     lane_hist = _lane_hist(disp)
     if lane_hist is not None:
         # Run-local lane views (see docstring): the per-lane histogram

@@ -188,6 +188,9 @@ def test_train_forward_parity(noise, seed):
     assert abs(Ej - Ec) / max(Ec, 1e-6) < 0.10
 
 
+# Tier-1 budget (TODO item 9, ISSUE 17): ~17s; tier-1 keeps the forward
+# parity sweep and the pose-agreement pins, full `pytest tests/` keeps this.
+@pytest.mark.slow
 def test_train_gradient_parity_x64():
     """Matched precision (jax x64) + refine=0: the cpp backward (analytic
     selection path + central differences through the solve, the reference's

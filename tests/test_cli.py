@@ -42,6 +42,13 @@ def test_train_expert_writes_checkpoint(pipeline_ckpts):
     assert (d / "e0" / "params").exists()
 
 
+# The three real CLI trainings below (~62s combined) are the TODO item 9
+# move-to-slow shortlist: tier-1 keeps the cheap script-surface checks
+# (checkpoint writes, eval CLIs, typed-rejection subprocess runs) and the
+# pipeline_ckpts fixture's train_expert/train_gating runs, so the CLI
+# training surface still executes at tier-1 — only the expensive
+# train_esac/train_expert END-TO-END variants move behind `pytest tests/`.
+@pytest.mark.slow
 def test_train_esac_end_to_end(pipeline_ckpts):
     d = pipeline_ckpts
     out = run(
@@ -70,12 +77,14 @@ def test_test_esac_reports_metrics(pipeline_ckpts, backend):
     assert f"backend={backend}" in out
 
 
+@pytest.mark.slow
 def test_train_expert_augment_flag(tmp_path):
     run("train_expert.py", "synth0", "--cpu", "--size", "test", "--batch", "2",
         "--iterations", "3", "--augment", "--output", str(tmp_path / "aug"))
     assert (tmp_path / "aug" / "config.json").exists()
 
 
+@pytest.mark.slow
 def test_train_esac_backend_cpp(pipeline_ckpts):
     """--backend cpp trains THROUGH the C++ extension (r1 verdict: the flag
     used to be silently ignored)."""

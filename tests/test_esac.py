@@ -43,7 +43,13 @@ def test_esac_infer_picks_correct_expert():
     assert r_err < 5.0 and t_err < 0.05
 
 
-@pytest.mark.parametrize("mode", ["dense", "sampled"])
+@pytest.mark.parametrize("mode", [
+    "dense",
+    # Tier-1 budget (TODO item 9, ISSUE 17): the sampled leg is ~19s; the
+    # REINFORCE estimator's gradient keeps tier-1 coverage via
+    # test_sampled_reinforce_gating_gradient_direction below.
+    pytest.param("sampled", marks=pytest.mark.slow),
+])
 def test_esac_train_loss_finite_and_gradient_flows(mode):
     coords_all, frame = make_multi_expert_frame(jax.random.key(2))
     logits = jnp.array([0.1, 1.0, -0.3, 0.2])

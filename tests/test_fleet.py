@@ -525,3 +525,75 @@ def test_heavy_concurrent_submit_quarantine_release_accounting_exact():
     # The router's nesting actually exercised (not vacuously clean).
     assert any(src.startswith("FleetRouter._lock")
                for (src, _d) in witness.edges())
+
+
+# ---------------- batched settle (ISSUE 17 host hot path) ----------------
+
+def test_batched_settle_outcome_counters_exactly_match_per_request():
+    """ISSUE 17: the router settles ALL ready completions in one critical
+    section and publishes their outcome counters / latency samples in
+    aggregate — the published numbers must equal a per-request count of
+    the actual outcomes exactly, with the observed lock order inside the
+    committed .lock_graph.json over the whole run."""
+    from esac_tpu.lint.lockgraph import LOCK_GRAPH_NAME, load_graph
+    from esac_tpu.lint.witness import LockWitness
+
+    slo = SLOPolicy(watchdog_ms=500.0, watchdog_poll_ms=10.0)
+    reps = []
+    for i in range(2):
+        disp = MicroBatchDispatcher(_echo, CFG, slo=slo, start_worker=False)
+        reps.append(Replica(f"r{i}", disp))
+    router = FleetRouter(reps, FleetPolicy(poll_ms=5.0), start=False)
+    witness = LockWitness()
+    witness.attach_fleet(router=router)
+    for rep in reps:
+        rep.dispatcher.start()
+    router.start()
+
+    N_THREADS, N_REQS = 3, 25
+    results = [[] for _ in range(N_THREADS)]
+
+    def submitter(tid):
+        for i in range(N_REQS):
+            try:
+                req = router.submit(_frame(i), scene=f"s{(tid + i) % 3}",
+                                    deadline_ms=5_000)
+                req.event.wait(10.0)
+                results[tid].append(req.outcome)
+            except ShedError:
+                results[tid].append("shed")
+
+    threads = [threading.Thread(target=submitter, args=(t,))
+               for t in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # Drain: every request reached a terminal class before we compare.
+    deadline = time.time() + 10.0
+    while router.fleet_totals()["pending"] and time.time() < deadline:
+        time.sleep(0.01)
+
+    per_request = {}
+    for r in results:
+        for o in r:
+            per_request[o] = per_request.get(o, 0) + 1
+    t = _totals_consistent(router)
+    assert t["pending"] == 0
+    assert sum(per_request.values()) == N_THREADS * N_REQS
+    counters = router._m_outcomes
+    for outcome, n in per_request.items():
+        assert counters.get(outcome=outcome) == n == t[outcome], outcome
+    # The aggregated latency publish: one sample per served+degraded.
+    good = per_request.get("served", 0) + per_request.get("degraded", 0)
+    assert router.obs.get(
+        "fleet_request_latency_seconds").summary()["count"] == good
+    router.close()
+
+    committed = load_graph(
+        pathlib.Path(__file__).resolve().parent.parent / LOCK_GRAPH_NAME
+    )
+    assert committed is not None
+    witness.assert_subgraph(committed)
+    assert any(src.startswith("FleetRouter._lock")
+               for (src, _d) in witness.edges())

@@ -1,0 +1,75 @@
+"""tools/hostpath_profile.py unit tests (ISSUE 17).
+
+The profiler's aggregation is pure host code designed for unit testing:
+``stage_table`` and ``host_overhead_summary`` get exact-value pins here;
+the end-to-end path (fixture build, traced dispatcher, capacity protocol)
+is exercised by ``bench.py hostpath`` and its bench-guard contract tests —
+not re-run here (it costs ~a minute of real serving).
+"""
+
+import importlib.util
+import pathlib
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _profiler():
+    spec = importlib.util.spec_from_file_location(
+        "hostpath_profile", REPO / "tools" / "hostpath_profile.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_stage_table_exact_aggregation():
+    prof = _profiler()
+    durs = [
+        {"staged": 1e-3, "device": 3e-3},
+        {"staged": 2e-3, "device": 1e-3},
+        {"staged": 3e-3, "device": 2e-3},
+    ]
+    t = prof.stage_table(durs)
+    assert set(t) == {"staged", "device"}
+    s = t["staged"]
+    assert s["count"] == 3
+    assert s["mean_ms"] == 2.0
+    assert s["p50_ms"] == 2.0
+    assert s["p99_ms"] == 3.0  # nearest-rank over 3 samples
+    # Shares are of the SUMMED wall and cover it exactly.
+    assert s["share"] == 0.5
+    assert t["device"]["share"] == 0.5
+    assert abs(sum(x["share"] for x in t.values()) - 1.0) < 1e-9
+
+
+def test_stage_table_handles_missing_stages_per_request():
+    prof = _profiler()
+    # A shed request never reaches "device": rows aggregate per stage, so
+    # counts can differ per stage without corrupting shares.
+    t = prof.stage_table([
+        {"staged": 1e-3, "device": 1e-3},
+        {"staged": 1e-3},
+    ])
+    assert t["staged"]["count"] == 2
+    assert t["device"]["count"] == 1
+    assert abs(sum(x["share"] for x in t.values()) - 1.0) < 1e-9
+
+
+def test_host_overhead_summary_splits_device_out():
+    prof = _profiler()
+    out = prof.host_overhead_summary([
+        {"staged": 2e-3, "device": 1e-3, "sliced": 1e-3},
+        {"staged": 4e-3, "device": 3e-3, "sliced": 2e-3},
+    ])
+    assert out["host_ms_per_request_mean"] == 4.5
+    assert out["device_ms_per_request_mean"] == 2.0
+    assert out["host_share"] == round(9.0 / 13.0, 4)
+
+
+def test_profiler_operating_point_matches_fleet_bench():
+    """The capacity gate only means something if the profiler measures at
+    the EXACT committed-fleet-bench operating point."""
+    import bench
+
+    prof = _profiler()
+    assert (prof.HW, prof.M, prof.N_HYPS, prof.FRAME_BUCKET) == (
+        bench.FLEET_HW, bench.FLEET_M, bench.FLEET_HYPS, bench.FLEET_BUCKET)

@@ -1123,3 +1123,62 @@ def test_snapshot_and_admission_never_block_on_wedged_dispatch():
     finally:
         release.set()
         disp.close()
+
+
+# ---------------- batched publishes (ISSUE 17 host hot path) ----------------
+#
+# The serving hot path publishes per-dispatch (observe_many / counter
+# inc(n=...)), not per-request.  The contract: the batched path is
+# sample-for-sample IDENTICAL to a loop of scalar observes — same bucket
+# increments, same lifetime stream, epoch rotation after every sample —
+# so snapshots cannot tell the two apart.
+
+def test_histogram_observe_many_identical_to_sequential():
+    import random
+
+    rng = random.Random(5)
+    xs = [rng.lognormvariate(-5.0, 1.0) for _ in range(5000)]
+    xs[100] = float("nan")   # the clamp cases ride the bulk path too
+    xs[200] = -1.0
+    xs[300] = float("inf")
+    a = StreamingHistogram(window=700, epochs=3)
+    b = StreamingHistogram(window=700, epochs=3)
+    for x in xs:
+        a.observe(x)
+    i = 0
+    for size in (1, 2, 3, 499, 1200, 7, 5000):  # 1200 > epoch cap: the
+        b.observe_many(xs[i:i + size])          # rotation lands MID-batch
+        i += size
+    b.observe_many([])  # empty batch is a no-op, not an epoch event
+    assert a._counts == b._counts
+    assert a._stats == b._stats
+    assert a._life_counts == b._life_counts
+    assert a._life_n == b._life_n and a._life_sum == b._life_sum
+    assert a.summary() == b.summary()
+
+
+def test_histogram_vec_observe_many_identical_to_sequential():
+    ra, rb = MetricsRegistry(), MetricsRegistry()
+    a = ra.histogram("h_seconds", "help")
+    b = rb.histogram("h_seconds", "help")
+    xs = [1e-3 * (1 + i % 13) for i in range(400)]
+    for x in xs:
+        a.observe(x, scene="s0", route_k="2")
+    b.observe_many(xs, scene="s0", route_k="2")
+    assert a.labelsets() == b.labelsets()
+    assert a.summary(scene="s0", route_k="2") == \
+        b.summary(scene="s0", route_k="2")
+
+
+def test_batched_latency_publish_counts_every_served_request():
+    """The dispatcher's per-dispatch bulk publish must still account one
+    latency sample and one outcome per request, not per dispatch."""
+    disp = MicroBatchDispatcher(_echo, CFG)
+    reqs = [disp.submit(_frame(float(i)), scene="s") for i in range(9)]
+    for r in reqs:
+        r.get(timeout=30.0)
+    disp.close()
+    assert disp.slo_totals()["served"] == 9
+    assert disp.obs.get("serve_request_latency_seconds").summary()["count"] == 9
+    lane = disp.obs.get("serve_lane_latency_seconds")
+    assert lane.summary(scene="s", route_k=None)["count"] == 9

@@ -419,3 +419,98 @@ def test_heavy_sharded_frames_matches_per_frame():
         # f32 + two IRLS rounds under different (vmap) codegen: ~2e-5 jitter
         np.testing.assert_allclose(batched[i]["rvec"], rvec, atol=1e-4)
         np.testing.assert_allclose(batched[i]["tvec"], tvec, atol=1e-4)
+
+
+# ---------------- staging cache (ISSUE 17 host hot path) ----------------
+#
+# The dispatch paths stage through StagingCache's pooled buffers instead of
+# rebuilding pad_batch(stack_frames(..)) allocations every dispatch.  The
+# contract is BIT-identity with the old composition in every case (typed
+# PRNG keys and dtype drift ride the verbatim fallback), plus the aliasing
+# discipline that makes buffer reuse safe on the zero-copy CPU backend.
+
+def _leaves_equal(a, b):
+    """Bit-equality that also covers typed PRNG-key leaves."""
+    try:
+        na, nb = np.asarray(a), np.asarray(b)
+    except (TypeError, ValueError):
+        na = np.asarray(jax.random.key_data(a))
+        nb = np.asarray(jax.random.key_data(b))
+    return na.dtype == nb.dtype and np.array_equal(na, nb)
+
+
+def test_staging_cache_bit_identical_to_pad_batch():
+    from esac_tpu.serve.batching import StagingCache
+
+    cache = StagingCache()
+    for n, bucket in ((1, 1), (2, 4), (3, 4), (4, 4)):
+        frames = _dsac_frames(n, seed=10 * n)
+        want, want_valid = pad_batch(stack_frames(frames), bucket=bucket)
+        got, got_valid = cache.stage(frames, bucket)
+        assert got_valid == want_valid
+        assert set(got) == set(want)
+        for k in want:
+            assert _leaves_equal(got[k], want[k]), (n, bucket, k)
+    with pytest.raises(ValueError):
+        cache.stage(_dsac_frames(3), 1)  # 3 frames do not fit bucket 1
+
+
+def test_staging_cache_rotates_depth_buffers_and_rejects_depth_1():
+    from esac_tpu.serve.batching import StagingCache
+
+    cache = StagingCache(depth=2)
+    frames = _dsac_frames(2)
+    t1, _ = cache.stage(frames, 4)
+    t2, _ = cache.stage(frames, 4)
+    t3, _ = cache.stage(frames, 4)
+    # numpy leaves ride the pool: depth-2 rotation returns the SAME buffer
+    # on every second stage, never on consecutive stages (the CPU
+    # device_put zero-copy aliasing rule).
+    assert t1["coords"] is t3["coords"]
+    assert t1["coords"] is not t2["coords"]
+    with pytest.raises(ValueError):
+        StagingCache(depth=1)
+
+
+def test_staging_cache_dtype_drift_falls_back_bit_identical():
+    from esac_tpu.serve.batching import StagingCache
+
+    cache = StagingCache()
+    frames = _dsac_frames(2, seed=30)
+    frames[1] = dict(frames[1], coords=frames[1]["coords"].astype(np.float64))
+    want, _ = pad_batch(stack_frames(frames), bucket=4)
+    got, _ = cache.stage(frames, 4)
+    # np.stack promotes f32+f64 -> f64; a pooled-buffer write would have
+    # silently cast.  The fallback must reproduce the promotion exactly.
+    assert np.asarray(want["coords"]).dtype == np.float64
+    for k in want:
+        assert _leaves_equal(got[k], want[k]), k
+
+
+def test_staging_cache_unalias_copies_only_pool_aliases():
+    from esac_tpu.serve.batching import StagingCache
+
+    cache = StagingCache()
+    tree, _ = cache.stage(_dsac_frames(2), 4)
+    view = tree["coords"][:1]          # aliases a pooled buffer
+    foreign = np.zeros(3, np.float32)  # does not
+    out = cache.unalias([view, foreign])
+    assert out[0] is not view and np.array_equal(out[0], view)
+    assert out[1] is foreign
+
+
+def test_echo_results_survive_staging_buffer_reuse():
+    """A passthrough program's host result can BE the pooled staging buffer
+    on the zero-copy CPU backend; every result must stay valid after the
+    pool rewrites that buffer (the ISSUE 17 unalias guarantee)."""
+    cfg = dataclasses.replace(CFG, frame_buckets=(2,), serve_max_wait_ms=0.0)
+
+    def echo(tree, scene=None, route_k=None):
+        return {"x": tree["x"]}
+
+    disp = MicroBatchDispatcher(echo, cfg, start_worker=False)
+    outs = [disp.infer_one({"x": np.full(4, float(i), np.float32)}, scene="s")
+            for i in range(6)]
+    for i, o in enumerate(outs):
+        assert np.array_equal(np.asarray(o["x"]),
+                              np.full(4, float(i), np.float32)), i

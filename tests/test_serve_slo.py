@@ -1005,6 +1005,9 @@ def test_run_open_loop_records_typed_error_classes():
         uniform_arrivals(200.0, 20),  # floods the depth-2 queue: sheds
         deadline_ms=5_000.0,
         hyps_per_request=1,
+        # The pre-freeze gc.collect() can outlast the 0.3s wedge window on
+        # a full-suite heap, releasing the gate before any request sheds.
+        freeze_gc=False,
     )
     disp.close()
     errs = res["per_request_error_types"]
@@ -1016,3 +1019,25 @@ def test_run_open_loop_records_typed_error_classes():
             assert e == "ShedError", (o, e)
         elif o == "served":
             assert e is None, (o, e)
+
+
+def test_run_open_loop_gc_provenance_and_unfreeze():
+    """ISSUE 17 satellite: the run executes with the prewarm heap frozen
+    (gen-2 pauses off the measured tail), records the provenance in the
+    summary, and ALWAYS unfreezes — including when freezing is declined."""
+    import gc
+
+    cfg = dataclasses.replace(CFG, frame_buckets=(1,), serve_max_wait_ms=0.0)
+    disp = MicroBatchDispatcher(_echo, cfg, slo=SLOPolicy(deadline_ms=2_000))
+    res = run_open_loop(disp, lambda i: (_frame(i), "s", None),
+                        uniform_arrivals(400.0, 20), deadline_ms=2_000.0)
+    assert res["gc"]["frozen"] is True
+    assert len(res["gc"]["collections_during_run"]) == 3
+    assert all(isinstance(c, int) for c in res["gc"]["collections_during_run"])
+    assert gc.get_freeze_count() == 0  # unfrozen after the run
+    res2 = run_open_loop(disp, lambda i: (_frame(i), "s", None),
+                         uniform_arrivals(400.0, 10), deadline_ms=2_000.0,
+                         freeze_gc=False)
+    disp.close()
+    assert res2["gc"]["frozen"] is False
+    assert gc.get_freeze_count() == 0

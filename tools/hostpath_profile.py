@@ -1,0 +1,286 @@
+#!/usr/bin/env python3
+"""Stage-attributed host-path profile of the serving hot path (ISSUE 17).
+
+The fleet bench pinned the problem: per-replica capacity is ~630 rps at
+toy shapes with device time a fraction of the 3.2 ms closed-loop
+dispatch — the Python HOST path (stack/pad staging, result slicing, obs
+publishes, lock traffic) sets the knee, not the chip.  This tool names
+where each request's wall actually goes, riding the span-trace stage
+segments the dispatcher already stamps (DESIGN.md §14 — zero new
+instrumentation):
+
+  admitted -> coalesced -> staged -> dispatched -> device -> sliced ->
+  outcome
+
+Each consecutive-stamp diff is attributed to the LATER stage, so the
+table below reads as "time spent reaching this stage":
+
+  coalesced   queue wait until the worker popped the request
+  staged      host staging: stack + pad + device_put
+  dispatched  issuing the async device call
+  device      device compute (the block_until_ready wait)
+  sliced      host transfer + per-request result slicing
+  <outcome>   fan-out: accounting, obs publishes, event set
+
+Two measurements, both CPU-forced (the relay is never touched):
+
+- **stage table**: N traced closed-loop requests through the worker at
+  ``serve_max_wait_ms=0`` (coalescing off — pure per-dispatch host cost,
+  no artificial hold window); per-stage mean/p50/p99 and share of the
+  end-to-end wall.
+- **closed-loop capacity**: the exact ``.fleet_serve.json`` protocol —
+  median of 5 ``infer_many(pool[:FRAME_BUCKET])`` walls at the fleet
+  bench's operating point -> requests/s per replica.
+
+Run before and after a host-path change; the two stage tables are the
+evidence DESIGN.md §21 commits.  ``python tools/hostpath_profile.py
+[--requests N] [--out FILE]`` prints one indented JSON document.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import math
+import pathlib
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+# The fleet bench's toy operating point (bench.py FLEET_*): tiny scenes on
+# purpose — the host path is what's being measured, not CNN throughput.
+HW = 24
+M = 2
+N_HYPS = 4
+FRAME_BUCKET = 2
+SCENES = 2
+
+
+def stage_table(per_request_durations: list[dict]) -> dict:
+    """Aggregate per-request ``SpanChain.durations()`` dicts into the
+    per-stage table: count, mean/p50/p99 ms, and share of the summed
+    end-to-end wall.  Pure function (no jax) — unit-tested."""
+    stages: dict[str, list[float]] = {}
+    totals = []
+    for durs in per_request_durations:
+        totals.append(math.fsum(durs.values()))
+        for stage, dt in durs.items():
+            stages.setdefault(stage, []).append(dt)
+    wall = math.fsum(totals)
+
+    def q(sorted_xs, p):
+        return sorted_xs[min(len(sorted_xs) - 1,
+                             round(p * (len(sorted_xs) - 1)))]
+
+    out = {}
+    for stage, xs in stages.items():
+        xs_sorted = sorted(xs)
+        s = math.fsum(xs)
+        out[stage] = {
+            "count": len(xs),
+            "mean_ms": round(s / len(xs) * 1e3, 4),
+            "p50_ms": round(q(xs_sorted, 0.5) * 1e3, 4),
+            "p99_ms": round(q(xs_sorted, 0.99) * 1e3, 4),
+            "share": round(s / wall, 4) if wall > 0 else None,
+        }
+    return out
+
+
+def host_overhead_summary(per_request_durations: list[dict]) -> dict:
+    """Host vs device split per request: everything that is not the
+    ``device`` stage is host-path cost (the optimization target)."""
+    host, device = [], []
+    for durs in per_request_durations:
+        d = durs.get("device", 0.0)
+        device.append(d)
+        host.append(math.fsum(durs.values()) - d)
+    n = max(len(host), 1)
+    return {
+        "host_ms_per_request_mean": round(math.fsum(host) / n * 1e3, 4),
+        "device_ms_per_request_mean": round(math.fsum(device) / n * 1e3, 4),
+        "host_share": round(
+            math.fsum(host) / max(math.fsum(host) + math.fsum(device),
+                                  1e-12), 4),
+    }
+
+
+def _build_fixture(root: pathlib.Path):
+    """One fleet-bench replica: SceneRegistry over tiny written scenes +
+    a MicroBatchDispatcher (worker off; callers pick the mode)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from esac_tpu.models import ExpertNet, GatingNet
+    from esac_tpu.ransac import RansacConfig
+    from esac_tpu.registry import (
+        SceneEntry, SceneManifest, ScenePreset, SceneRegistry,
+        compute_entry_checksums,
+    )
+    from esac_tpu.utils.checkpoint import save_checkpoint
+
+    H = W = HW
+    preset = ScenePreset(
+        height=H, width=W, num_experts=M,
+        stem_channels=(2, 4, 8), head_channels=8, head_depth=1,
+        gating_channels=(4,), compute_dtype="float32", gated=True,
+    )
+    cfg = RansacConfig(n_hyps=N_HYPS, refine_iters=2, polish_iters=1,
+                       frame_buckets=(FRAME_BUCKET,), serve_max_wait_ms=0.0,
+                       serve_queue_depth=256)
+    expert = ExpertNet(
+        scene_center=(0.0, 0.0, 0.0), stem_channels=preset.stem_channels,
+        head_channels=preset.head_channels, head_depth=preset.head_depth,
+        compute_dtype=jnp.float32,
+    )
+    gating = GatingNet(num_experts=M, channels=preset.gating_channels,
+                       compute_dtype=jnp.float32)
+    img0 = jnp.zeros((1, H, W, 3))
+    manifest = SceneManifest()
+    scenes = [f"s{i}" for i in range(SCENES)]
+    for seed, name in enumerate(scenes):
+        e_params = jax.vmap(lambda k: expert.init(k, img0))(
+            jax.random.split(jax.random.key(seed), M)
+        )
+        centers = (np.asarray([[0.0, 0.0, 2.0]], np.float32)
+                   + np.arange(M, dtype=np.float32)[:, None] * 0.1)
+        d = root / name
+        save_checkpoint(d / "expert", e_params, {
+            "stem_channels": list(preset.stem_channels),
+            "head_channels": preset.head_channels,
+            "head_depth": preset.head_depth,
+            "scene_centers": centers.tolist(),
+            "f": 40.0, "c": [W / 2.0, H / 2.0],
+        })
+        save_checkpoint(d / "gating",
+                        gating.init(jax.random.key(1000 + seed), img0),
+                        {"num_experts": M})
+        manifest.add(compute_entry_checksums(SceneEntry(
+            scene_id=name, version=1,
+            expert_ckpt=str(d / "expert"), gating_ckpt=str(d / "gating"),
+            preset=preset, ransac=cfg,
+        )))
+
+    def frame(i):
+        return {
+            "key": jax.random.fold_in(jax.random.key(7), i),
+            "image": np.asarray(jax.random.uniform(
+                jax.random.fold_in(jax.random.key(42), i), (H, W, 3)
+            )),
+        }
+
+    pool = [frame(i) for i in range(8)]
+    registry = SceneRegistry(manifest)
+    return registry, cfg, scenes, pool
+
+
+def profile(n_requests: int = 300, capacity_reps: int = 5,
+            freeze_gc: bool = True) -> dict:
+    """Run both measurements; returns the artifact dict."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # CLAUDE.md: never the relay
+
+    from esac_tpu.serve import MicroBatchDispatcher
+
+    root = pathlib.Path(tempfile.mkdtemp(prefix="esac_hostpath_"))
+    frozen = False
+    try:
+        registry, cfg, scenes, pool = _build_fixture(root)
+
+        # ---- closed-loop capacity (the .fleet_serve.json protocol) ----
+        disp = MicroBatchDispatcher(registry.infer_fn(), cfg,
+                                    start_worker=False)
+        registry.bind_obs(disp.obs)
+        for j, s in enumerate(scenes):  # prewarm: compile + weights staged
+            disp.infer_one(pool[j % len(pool)], scene=s)
+        compiled_before = registry.compile_cache_size()
+        # ISSUE 17 satellite: prewarm built the long-lived heap (weights,
+        # compiled programs, dispatcher) — freeze it so a mid-window gen-2
+        # pass cannot stall either measured loop; provenance in the doc.
+        if freeze_gc:
+            gc.collect()
+            gc.freeze()
+            frozen = True
+        gc_before = gc.get_stats()
+        walls = []
+        for _ in range(capacity_reps):
+            t0 = time.perf_counter()
+            disp.infer_many(pool[:FRAME_BUCKET], scene=scenes[0])
+            walls.append(time.perf_counter() - t0)
+        dispatch_s = sorted(walls)[len(walls) // 2]
+        capacity = {
+            "closed_loop_dispatch_ms": round(dispatch_s * 1e3, 3),
+            "per_replica_capacity_rps": round(FRAME_BUCKET / dispatch_s, 2),
+            "reps": capacity_reps,
+        }
+
+        # ---- stage table: traced closed-loop requests via the worker ----
+        traced = MicroBatchDispatcher(registry.infer_fn(), cfg,
+                                      start_worker=True, trace=True)
+        registry.bind_obs(traced.obs)
+        traced.infer_one(pool[0], scene=scenes[0])  # worker-path warmup
+        durations = []
+        t0 = time.perf_counter()
+        for i in range(n_requests):
+            req = traced.submit(pool[i % len(pool)],
+                                scene=scenes[i % len(scenes)])
+            req.get(timeout=30.0)
+            durations.append(req.spans.durations())
+        span = time.perf_counter() - t0
+        totals = traced.slo_totals()
+        traced.close()
+        compiled_after = registry.compile_cache_size()
+        disp.close()
+
+        return {
+            "operating_point": {
+                "hw": [HW, HW], "num_experts": M, "n_hyps": N_HYPS,
+                "frame_bucket": FRAME_BUCKET, "scenes": SCENES,
+                "serve_max_wait_ms": 0.0,
+            },
+            "requests": n_requests,
+            "closed_loop_rps_traced_path": round(n_requests / span, 2),
+            "stage_table": stage_table(durations),
+            "host_overhead": host_overhead_summary(durations),
+            "capacity": capacity,
+            "accounting": totals,
+            "compiled_programs": {
+                "before": compiled_before, "after": compiled_after,
+                "hot_path_recompiles": compiled_after - compiled_before,
+            },
+            "gc": {
+                "frozen": frozen,
+                "collections_during_run": [
+                    int(a["collections"] - b["collections"])
+                    for a, b in zip(gc.get_stats(), gc_before)
+                ],
+            },
+            "platform": jax.default_backend(),
+        }
+    finally:
+        if frozen:
+            gc.unfreeze()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=300)
+    ap.add_argument("--out", type=str, default=None,
+                    help="also write the JSON document here")
+    args = ap.parse_args()
+    out = profile(n_requests=args.requests)
+    doc = json.dumps(out, indent=1)
+    if args.out:
+        pathlib.Path(args.out).write_text(doc + "\n")
+    print(doc)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
