@@ -79,12 +79,17 @@ import threading
 import time
 
 from esac_tpu.obs import MetricsRegistry, Trace
+from esac_tpu.retrieval.errors import (
+    RetrievalCandidatesExhaustedError,
+    RetrievalMissError,
+)
 from esac_tpu.serve.slo import (
     ConfigError,
     DeadlineExceededError,
     DispatcherClosedError,
     DispatchStalledError,
     LaneQuarantinedError,
+    ServeError,
     ShedError,
     WorkerDiedError,
 )
@@ -364,6 +369,12 @@ class FleetRouter:
         # snapshot schema is unchanged.
         self._trace_store = (self.obs.trace_store()
                              if policy.trace_sample else None)
+        # Retrieval front-end (ISSUE 18): attach_retrieval installs it;
+        # image-only requests (infer_image) carry no scene id and are
+        # book-kept by the front, not the fleet books — each candidate
+        # dispatch below them is an ordinary fleet request.
+        self._retrieval = None
+        self._image_seq = 0
         self._thread = None
         if start:
             self.start()
@@ -524,6 +535,190 @@ class FleetRouter:
             grace = remaining + 4 * self._policy.poll_ms / 1e3 + 0.25
             limit = grace if limit is None else min(limit, grace)
         return req.get(limit)
+
+    # ---------------- image-only request path (ISSUE 18) ----------------
+
+    def attach_retrieval(self, front) -> None:
+        """Install the retrieval front-end: wires the default per-scene
+        breaker gate (a candidate is healthy when ANY replica registry
+        still has prefetchable targets for it — i.e. it is not
+        breaker-tripped everywhere), feeds every replica prefetcher from
+        the posterior (the ``observe_candidates`` seam), and registers
+        the ``retrieval`` obs collector.  One front per router."""
+        with self._lock:
+            if self._retrieval is not None:
+                raise ConfigError(
+                    "a retrieval front is already attached to this router"
+                )
+            self._retrieval = front
+        if not front.has_health():
+            front.attach_health(self._candidate_healthy)
+        for rep in self._replicas.values():
+            pf = getattr(rep.registry, "_prefetcher", None)
+            if pf is not None and hasattr(pf, "observe_candidates"):
+                front.add_prefetch_sink(pf.observe_candidates)
+        self.obs.register_collector("retrieval", front.stats)
+
+    def _candidate_healthy(self, scene) -> bool:
+        """Default retrieval breaker gate: ``prefetch_targets`` is the
+        registries' health-aware resolution (active + canary minus
+        tripped), so "no targets anywhere" == "tripped/unknown
+        everywhere" — exactly the candidates that must be SKIPPED, not
+        dispatched.  Runs with NO router lock held (registry locks
+        inside)."""
+        regs = [rep.registry for rep in self._replicas.values()
+                if rep.registry is not None]
+        if not regs:
+            return True  # bare-dispatcher fleet: no breaker state exists
+        return any(reg.prefetch_targets(scene) for reg in regs)
+
+    def infer_image(self, frame, route_k=None,
+                    timeout: float | None = None,
+                    deadline_ms: float | None = None):
+        """Blocking IMAGE-ONLY inference: no scene id — the retrieval
+        front decides the top-K candidate scenes (each breaker-gated),
+        every candidate is dispatched through the ordinary fleet path,
+        and the winner is chosen by soft-inlier score.  Typed faults:
+        :class:`~esac_tpu.retrieval.errors.RetrievalMissError` (shed —
+        low confidence / empty index / all candidates tripped) and
+        :class:`~esac_tpu.retrieval.errors.\
+RetrievalCandidatesExhaustedError` (failed — every candidate dispatch
+        died).  The image request books EXACTLY one outcome in the
+        front's accounting; the per-candidate fleet requests carry
+        their own books underneath.  A sampled trace gets a
+        ``retrieval`` root segment + per-candidate dispatch child spans
+        (the §14 telescoping invariant at image scope)."""
+        with self._lock:
+            front = self._retrieval
+        if front is None:
+            raise ConfigError(
+                "no retrieval front attached — attach_retrieval() first"
+            )
+        if deadline_ms is None and timeout is not None:
+            deadline_ms = timeout * 1e3
+        t0 = self._clock()
+        deadline = (t0 + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        trace = None
+        with self._lock:
+            if self._closed:
+                raise DispatcherClosedError("fleet router is closed")
+            self._image_seq += 1
+            n = self._policy.trace_sample
+            if n and self._image_seq % n == 0:
+                trace = Trace(t0, scene=None, sampled_1_in=n)
+        tok = front.offer()
+        try:
+            try:
+                decision = front.decide(frame)
+            except RetrievalMissError as e:
+                # Typed retrieval shed: no candidate was dispatchable.
+                tok.book("shed", e)
+                raise
+            t_dec = self._clock()
+            if trace is not None:
+                # Root boundary: everything up to here is the retrieval
+                # decision (index snapshot + jitted posterior + gates).
+                trace.stamp("retrieval", t_dec)
+                trace.add_event(
+                    "retrieval_decision", t_dec,
+                    candidates=list(decision.candidates),
+                    top1=decision.top1, top1_p=decision.top1_p,
+                    entropy=decision.entropy,
+                    tripped_skipped=decision.tripped_skipped,
+                )
+            # Posterior-driven prefetch: runner-up scenes stage ahead
+            # of their fault, whatever candidate wins below.
+            front.feed_prefetch(decision)
+            # Fan out: submit every candidate first (admission only),
+            # then collect — candidates overlap in flight instead of
+            # paying each other's latency.
+            submitted = []
+            last_err = None
+            for cand in decision.candidates:
+                now = self._clock()
+                if deadline is not None and now >= deadline:
+                    break
+                remaining_ms = (None if deadline is None
+                                else (deadline - now) * 1e3)
+                try:
+                    submitted.append((cand, self.submit(
+                        frame, scene=cand, route_k=route_k,
+                        deadline_ms=remaining_ms,
+                    )))
+                except ServeError as e:
+                    # Per-candidate admission fault (shed/quarantine/
+                    # dead deadline): counted in the fleet books by
+                    # submit itself; the image request survives on the
+                    # remaining candidates.
+                    last_err = e
+            results = []
+            for cand, req in submitted:
+                limit = None
+                if req.deadline is not None:
+                    remaining = max(0.0, req.deadline - self._clock())
+                    limit = (remaining
+                             + 4 * self._policy.poll_ms / 1e3 + 0.25)
+                try:
+                    results.append((cand, req.get(limit)))
+                except ServeError as e:
+                    last_err = e
+                if trace is not None:
+                    trace.add_span(
+                        f"candidate:{cand}", "dispatch",
+                        req.t_submit, req.t_done or self._clock(),
+                        scene=cand, outcome=req.outcome,
+                    )
+            if trace is not None:
+                trace.stamp("candidates", self._clock())
+            if results:
+                winner_scene, wres = front.select_winner(results)
+                # The winning replica answer is returned UNTOUCHED
+                # under its own keys (the confident-query bit-identity
+                # contract); retrieval evidence rides alongside.
+                out = dict(wres)
+                out["retrieval"] = {
+                    "scene": winner_scene,
+                    "candidates": list(decision.candidates),
+                    "top1": decision.top1,
+                    "top1_p": decision.top1_p,
+                    "entropy": decision.entropy,
+                }
+                front.note_result(winner_scene, decision)
+                tok.book("served")
+                self._finish_image_trace(trace, "served")
+                return out
+            if deadline is not None and self._clock() >= deadline:
+                expired_err = DeadlineExceededError(
+                    "image request deadline died across "
+                    f"{len(decision.candidates)} candidate dispatch(es)"
+                )
+                tok.book("expired", expired_err)
+                raise expired_err
+            exhausted_err = RetrievalCandidatesExhaustedError(
+                f"all {len(decision.candidates)} candidate dispatch(es) "
+                f"failed (last: {last_err!r})"
+            )
+            tok.book("failed", exhausted_err)
+            raise exhausted_err
+        except BaseException as e:  # noqa: BLE001 — accounting backstop
+            # Every error path lands exactly one outcome (the booking
+            # token is first-wins, so typed paths above keep theirs);
+            # the trace finishes with whatever was booked.
+            tok.book("failed", e)
+            self._finish_image_trace(trace, tok.outcome or "failed")
+            raise
+
+    def _finish_image_trace(self, trace, outcome: str) -> None:
+        """Terminal root stamp + store publication for one image-request
+        trace (idempotent through Trace.finish: racing error paths store
+        it exactly once; the append is a leaf-lock deque op, R13-clean)."""
+        if trace is None:
+            return
+        with self._lock:
+            store = self._trace_store
+        if trace.finish(outcome, self._clock()) and store is not None:
+            store.add(trace)
 
     def _dispatch_to_replica(self, req: FleetRequest, exclude: set,
                              route=None) -> None:

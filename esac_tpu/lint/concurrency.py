@@ -57,7 +57,7 @@ _EXEMPT_METHODS = {"__init__", "__new__", "__del__"}
 def _r10_scope(rel: str) -> bool:
     return rel.startswith(
         ("esac_tpu/serve/", "esac_tpu/registry/", "esac_tpu/obs/",
-         "esac_tpu/fleet/")
+         "esac_tpu/fleet/", "esac_tpu/retrieval/")
     )
 
 

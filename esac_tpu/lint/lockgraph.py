@@ -69,9 +69,10 @@ from esac_tpu.lint.suppress import is_suppressed, parse_suppressions
 LOCK_GRAPH_NAME = ".lock_graph.json"
 
 # The fleet scope the graph covers (ISSUE 14 added the replica-fleet
-# scheduler tier, whose router lock nests over the obs instruments)...
+# scheduler tier, whose router lock nests over the obs instruments;
+# ISSUE 18 the retrieval front-end, whose front/index locks are LEAVES)...
 FLEET_PREFIXES = ("esac_tpu/serve/", "esac_tpu/registry/", "esac_tpu/obs/",
-                  "esac_tpu/fleet/")
+                  "esac_tpu/fleet/", "esac_tpu/retrieval/")
 # ...and what triggers the pass in --changed mode (the analysis itself
 # rides in esac_tpu/lint/, so editing it must re-run the gate).
 PASS_PREFIXES = FLEET_PREFIXES + ("esac_tpu/lint/",)

@@ -415,6 +415,26 @@ def _build_registry_scene_serve():
     return jax.make_jaxpr(fn)(params, batch)
 
 
+def _build_retrieval_posterior():
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.retrieval.model import (
+        RetrievalConfig,
+        build_retriever,
+        make_retrieval_fn,
+    )
+
+    cfg = RetrievalConfig(height=16, width=16, max_scenes=8, embed_dim=4,
+                          channels=(2,))
+    fn = make_retrieval_fn(cfg)
+    img = jnp.zeros((1, cfg.height, cfg.width, 3))
+    params = build_retriever(cfg).init(jax.random.key(0), img)
+    prototypes = jnp.zeros((cfg.max_scenes, cfg.embed_dim))
+    mask = jnp.zeros((cfg.max_scenes,), bool)
+    return jax.make_jaxpr(fn)(params, prototypes, mask, img)
+
+
 def _build_sharded_train():
     import jax
 
@@ -558,6 +578,15 @@ ENTRIES: tuple[Entry, ...] = (
                "in production presets so dot precision is not audited, but "
                "primitives/static-shapes are — the hot-swap path must stay "
                "scan/while-free and fixed-shape"),
+    Entry("retrieval_posterior", pinned=False,
+          build=_build_retrieval_posterior,
+          note="scene-retrieval forward (esac_tpu.retrieval, ISSUE 18): "
+               "embedder CNN -> unit embedding -> masked cosine logits "
+               "over the static max_scenes prototype axis -> posterior; "
+               "prototypes and mask are TRACED arguments so "
+               "enroll/remove never recompile; CNN compute follows the "
+               "gating-net policy (bf16-eligible) so dot precision is "
+               "not audited, but primitives/static-shapes are"),
     Entry("sharded_infer_frames_dynamic", pinned=True,
           build=_build_sharded_infer_frames_dynamic,
           note="registry-backed expert-sharded frames-major inference "

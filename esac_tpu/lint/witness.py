@@ -214,6 +214,15 @@ class LockWitness:
                 if infer is not None and hasattr(infer, "_lock") and \
                         hasattr(infer, "stall_once"):
                     self.attach(infer, "_lock")  # a tagged FaultInjector
+            front = getattr(router, "_retrieval", None)
+            if front is not None:
+                # ISSUE 18: the retrieval front + its scene index are
+                # LEAF locks (taken sequentially, never nested under
+                # each other or the router lock).
+                self.attach(front, "_lock")
+                idx = getattr(front, "_index", None)
+                if idx is not None and hasattr(idx, "_lock"):
+                    self.attach(idx, "_lock")
         return self
 
     @staticmethod

@@ -39,9 +39,18 @@ KNOWN_COLLECTORS = {
     "host_tier": ("hits", "misses", "admissions", "resident",
                   "bytes_in_use"),
     "prefetch": ("issued_device", "issued_host", "hits", "wasted",
-                 "failures", "cycles"),
+                 "failures", "posterior_feeds", "cycles"),
     # replica fleet (ISSUE 14)
     "fleet": (),                          # per-replica merge (dynamic)
+    # retrieval front-end (ISSUE 18): image-tier accounting + recall
+    # proxies + posterior evidence
+    "retrieval": ("offered", "served", "shed", "expired", "failed",
+                  "pending", "decided", "missed_low_confidence",
+                  "missed_no_candidate", "missed_tripped",
+                  "tripped_skipped", "posterior_entropy_mean",
+                  "candidate_fanout_mean", "winners_noted", "top1_hits",
+                  "winner_in_topk", "recall_proxy_top1",
+                  "prefetch_feeds", "enrolled"),
     # runtime lock witness (graft-audit v3; test/bench attach only)
     "lock_witness": (),
     # runtime outcome witness (graft-audit v5; test/bench attach only)

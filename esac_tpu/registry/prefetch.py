@@ -158,6 +158,7 @@ class WeightPrefetcher:
         self.prefetch_wasted = 0
         self.prefetch_failures = 0
         self.feed_errors = 0
+        self.posterior_feeds = 0
         self.cycles = 0
 
     # ---- the arrival feed (dispatcher hot path; must never block) ----
@@ -169,7 +170,27 @@ class WeightPrefetcher:
         try:
             t = self._clock()
             with self._lock:
-                self._arrivals.append((scene, t))
+                self._arrivals.append((scene, t, 1.0))
+        except Exception:  # noqa: BLE001 — the feed must never hurt serving
+            with self._lock:
+                self.feed_errors += 1
+
+    def observe_candidates(self, weights) -> None:
+        """Posterior-weighted arrivals from the retrieval front (ISSUE
+        18, DESIGN.md §22): ``weights`` is ``[(scene, p), ...]`` over
+        one image request's candidate posterior.  Each scene's score
+        credit is scaled by its posterior mass — an ambiguous query
+        stages its runner-up scenes AHEAD of the fault, at a fraction
+        of a full arrival, so retrieval uncertainty ranks below real
+        demand but above nothing.  Same contract as :meth:`observe`:
+        bounded, non-blocking, never raises."""
+        try:
+            t = self._clock()
+            items = [(scene, t, float(w)) for scene, w in weights
+                     if w > 0.0]
+            with self._lock:
+                self._arrivals.extend(items)
+                self.posterior_feeds += 1
         except Exception:  # noqa: BLE001 — the feed must never hurt serving
             with self._lock:
                 self.feed_errors += 1
@@ -238,10 +259,10 @@ class WeightPrefetcher:
             else:
                 self._scores[s] = v
         self._scored_at = now
-        for scene, t in drained:
+        for scene, t, w in drained:
             back = math.exp(-math.log(2.0) * max(now - t, 0.0)
                             / self._policy.halflife_s)
-            self._scores[scene] = self._scores.get(scene, 0.0) + back
+            self._scores[scene] = self._scores.get(scene, 0.0) + back * w
         return drained
 
     def run_cycle(self) -> dict:
@@ -266,7 +287,7 @@ class WeightPrefetcher:
         # Credit the arrivals that a still-resident prefetch absorbed:
         # the prediction was right and the fault never happened.
         hits = []
-        for scene, _ in drained:
+        for scene, _t, _w in drained:
             for key in list(credit):
                 if key[0] == scene and (key in cache or
                                         (tier is not None and key in tier)):
@@ -361,6 +382,7 @@ class WeightPrefetcher:
                 "wasted": self.prefetch_wasted,
                 "failures": self.prefetch_failures,
                 "feed_errors": self.feed_errors,
+                "posterior_feeds": self.posterior_feeds,
                 "cycles": self.cycles,
                 "in_credit": len(self._credit),
                 "tracked_scenes": len(self._scores),

@@ -1,0 +1,50 @@
+"""Scene retrieval front-end (ISSUE 18, DESIGN.md §22): image-only
+requests resolve "which scene am I in?" through a coarse retriever
+posterior before the fleet's routed expert dispatch decides which
+experts.  See model.py (the jitted forward), index.py (the no-recompile
+prototype table), front.py (candidate policy + accounting) and
+errors.py (the typed miss family); fleet/router.py's ``infer_image``
+is the request path over them.
+
+model.py imports jax/flax, so its exports resolve LAZILY (PEP 562):
+the jax-free host modules (fleet/router.py, the lint passes) import the
+errors and the front through this package without ever initializing a
+device backend — the obs-tier discipline."""
+
+from esac_tpu.retrieval.errors import (
+    RetrievalCandidatesExhaustedError,
+    RetrievalMissError,
+)
+from esac_tpu.retrieval.front import (
+    RetrievalDecision,
+    RetrievalFront,
+    RetrievalPolicy,
+)
+from esac_tpu.retrieval.index import SceneIndex
+
+_MODEL_EXPORTS = (
+    "RetrievalConfig",
+    "RetrieverNet",
+    "build_retriever",
+    "make_retrieval_fn",
+)
+
+__all__ = [
+    "RetrievalCandidatesExhaustedError",
+    "RetrievalDecision",
+    "RetrievalFront",
+    "RetrievalMissError",
+    "RetrievalPolicy",
+    "SceneIndex",
+    *_MODEL_EXPORTS,
+]
+
+
+def __getattr__(name: str):
+    if name in _MODEL_EXPORTS:
+        from esac_tpu.retrieval import model
+
+        return getattr(model, name)
+    raise AttributeError(  # graft-lint: disable=R16(PEP 562 module __getattr__ must raise AttributeError; import-time, never a request fault)
+        f"module {__name__!r} has no attribute {name!r}"
+    )

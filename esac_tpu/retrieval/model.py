@@ -1,0 +1,137 @@
+"""Retriever model: image -> scene embedding -> posterior over scenes.
+
+ESAC's gating CNN one level up (ISSUE 18, DESIGN.md §22): where
+``models/gating.py`` distributes hypotheses over the experts *within*
+a scene, the retriever distributes an image-only request over the
+*scenes of the whole fleet*.  Same conv trunk shape, but the head emits
+an L2-normalized embedding instead of fixed-arity logits: scene
+identities live in a per-scene PROTOTYPE table (``index.SceneIndex``)
+that is a TRACED argument of the one jitted forward — padded to a
+static ``max_scenes`` axis and masked, so scenes can be enrolled and
+removed without ever recompiling (the registry's no-recompile hot-swap
+contract, applied to retrieval).
+
+The forward is registered in ``lint/registry.py`` (R11,
+``retrieval_posterior``) and its resource profile is pinned in
+``.jaxpr_ledger.json``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections.abc import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from esac_tpu.utils.num import safe_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class RetrievalConfig:
+    """Static-shape config of the retrieval front (a frozen dataclass,
+    usable as a static jit argument like every other config).
+
+    ``max_scenes`` is the padded prototype axis — the fleet can enroll
+    at most this many scenes without a recompile; raising it is a new
+    program (a deliberate, observable compile at attach time, never on
+    the request path).  ``temperature`` scales the cosine logits before
+    the softmax (lower = sharper posterior)."""
+
+    height: int = 64
+    width: int = 64
+    max_scenes: int = 64
+    embed_dim: int = 32
+    channels: tuple[int, ...] = (16, 32, 64)
+    compute_dtype: str = "float32"
+    temperature: float = 0.1
+
+    def __post_init__(self):
+        if self.height < 1 or self.width < 1:
+            raise ValueError(f"bad retrieval input {self.height}x{self.width}")
+        if self.max_scenes < 1:
+            raise ValueError(f"max_scenes {self.max_scenes} < 1")
+        if self.embed_dim < 1:
+            raise ValueError(f"embed_dim {self.embed_dim} < 1")
+        if not self.channels:
+            raise ValueError("channels must be non-empty")
+        if not self.temperature > 0.0:
+            raise ValueError(f"temperature {self.temperature} must be > 0")
+
+
+class RetrieverNet(nn.Module):
+    """CNN embedder: RGB (..., H, W, 3) -> unit embedding (..., D).
+
+    The ``models/gating.py`` trunk (strided convs + global average
+    pool, configurable compute dtype / f32 params) with an embedding
+    head; the output is L2-normalized with the eps-inside-sqrt idiom so
+    a degenerate all-zero activation stays finite (CLAUDE.md grad
+    safety — prototypes are built from this output during enrollment).
+    """
+
+    embed_dim: int
+    channels: Sequence[int] = (16, 32, 64)
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.astype(self.compute_dtype)
+        for ch in self.channels:
+            x = nn.Conv(ch, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)),
+                        dtype=self.compute_dtype)(x)
+            x = nn.relu(x)
+            x = nn.Conv(ch, (3, 3), dtype=self.compute_dtype)(x)
+            x = nn.relu(x)
+        x = jnp.mean(x, axis=(-3, -2))  # global average pool
+        x = x.astype(jnp.float32)
+        x = nn.Dense(max(self.embed_dim * 2, 64), dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.embed_dim, dtype=jnp.float32)(x)
+        return x / safe_norm(x, axis=-1)[..., None]
+
+
+# Large-negative logit for masked prototype slots: softmax weight
+# underflows to exactly 0.0 in f32 without producing inf-inf NaNs the
+# way -inf logits would.
+_MASKED_LOGIT = -1e30
+
+
+def build_retriever(config: RetrievalConfig) -> RetrieverNet:
+    return RetrieverNet(
+        embed_dim=config.embed_dim,
+        channels=tuple(config.channels),
+        compute_dtype=jnp.dtype(config.compute_dtype),
+    )
+
+
+def make_retrieval_fn(config: RetrievalConfig):
+    """ONE jitted forward for the whole retrieval front:
+
+    ``fn(params, prototypes, mask, images) -> {"embedding", "posterior"}``
+
+    - ``prototypes`` (max_scenes, D) and ``mask`` (max_scenes,) are
+      TRACED arguments — enrolling/removing a scene re-dispatches the
+      SAME compiled program (the no-recompile contract; pinned by the
+      city drill's jit cache-miss counter).
+    - ``images`` is (B, H, W, 3); static shapes throughout, no
+      data-dependent control flow.
+
+    The returned fn exposes ``_cache_size()`` (the registry
+    ``infer_fn`` convention) so benches can pin zero hot-path
+    recompiles across index mutations.
+    """
+    model = build_retriever(config)
+
+    def _forward(params, prototypes, mask, images):
+        emb = model.apply(params, images)                    # (B, D) unit
+        logits = jnp.einsum("bd,md->bm", emb, prototypes)
+        logits = logits / jnp.float32(config.temperature)
+        logits = jnp.where(mask[None, :], logits, _MASKED_LOGIT)
+        return {
+            "embedding": emb,
+            "posterior": jax.nn.softmax(logits, axis=-1),
+        }
+
+    fn = jax.jit(_forward)
+    return fn

@@ -809,11 +809,12 @@ def test_all_mode_mains_share_the_wedge_safe_scaffold(monkeypatch):
                  bench._routed_main, bench._loadtest_main,
                  bench._scoring_main, bench._chaos_main,
                  bench._obs_main, bench._prefetch_main,
-                 bench._fleet_main, bench._hostpath_main):
+                 bench._fleet_main, bench._hostpath_main,
+                 bench._city_main):
         main([], [0.0, 0.0, 0.0])
     assert [c[0] for c in calls] == [
         "serve", "registry", "routed", "loadtest", "scoring", "chaos",
-        "obs", "prefetch", "fleet", "hostpath",
+        "obs", "prefetch", "fleet", "hostpath", "city",
     ]
 
 
@@ -1534,3 +1535,208 @@ def test_hostpath_artifact_schema_committed():
     assert hp["compiled_programs"]["hot_path_recompiles"] == 0
     assert hp["gc"]["frozen"] is True
     assert len(hp["gc"]["collections_during_run"]) == 3
+
+
+# ---------------- city retrieval driver contract (ISSUE 18) ----------------
+
+def _canned_city():
+    """Minimal-but-complete city payload: the schema the driver and the
+    committed .city_retrieval.json artifact rely on."""
+    def leg(k, recall):
+        return {
+            "top_k": k,
+            "offered": 30,
+            "outcomes": {"served": 25, "shed": 5},
+            "by_mix": {
+                "easy": {"offered": 16, "served": 16},
+                "hard": {"offered": 8, "served": 7, "shed": 1},
+                "junk": {"offered": 6, "served": 2, "shed": 4},
+            },
+            "recall_at_k": recall, "recall_hits": round(recall * 24),
+            "retrieval_top1_acc": 0.875,
+            "winner_accuracy_served": 0.8,
+            "served_p50_ms": 40.0, "served_p99_ms": 120.0,
+            "accounting_exact": True, "fleet_accounting_exact": True,
+            "bit_identical": True,
+            "front": {"offered": 30, "served": 25, "shed": 5,
+                      "expired": 0, "degraded": 0, "failed": 0,
+                      "pending": 0},
+        }
+
+    return {
+        "scenes": {"n": 24, "hw": [16, 16], "num_experts": 2,
+                   "n_hyps": 4, "frame_bucket": 1},
+        "replicas": 2,
+        "retriever": {"embed_dim": 16, "max_scenes": 32,
+                      "channels": [4, 8], "temperature": 0.1,
+                      "train_steps": 200, "train_s": 2.0,
+                      "final_loss": 0.1, "enroll_refs_per_scene": 4},
+        "calibration": {"min_confidence": 0.45, "easy_top1_p_p5": 0.97,
+                        "hard_top1_p_p5": 0.6, "junk_top1_p_p50": 0.33,
+                        "junk_top1_p_p95": 0.72},
+        "weight_cache": {"budget_bytes": 600000, "scene_bytes": 100000,
+                         "oversubscription_x": 4.0,
+                         "resident_scenes_max": 6},
+        "closed_loop_dispatch_ms": 40.0,
+        "deadline_ms": 8000.0, "watchdog_ms": 500.0,
+        "query_mix": {"easy": 16, "hard": 8, "junk": 6,
+                      "easy_noise": 0.05, "hard_noise": 0.35},
+        "legs": [leg(1, 0.7917), leg(2, 0.8333), leg(4, 0.875)],
+        "probes": {
+            "breaker": {"tripped_scene": "s0", "winner_before": "s0",
+                        "candidates_before": ["s0", "s1"],
+                        "candidates_tripped": ["s1", "s2"],
+                        "tripped_excluded": True,
+                        "tripped_skipped_delta": 1,
+                        "released_everywhere": True,
+                        "bit_identical_restore": True},
+            "exhausted": {"raised": True,
+                          "type": "RetrievalCandidatesExhaustedError",
+                          "retryable": True,
+                          "wire_name": "retrieval_candidates_exhausted"},
+        },
+        "posterior_prefetch_feeds": {"r0": 80, "r1": 80},
+        "compiled_programs": {"before_load": 4, "after_drill": 4,
+                              "hot_path_recompiles": 0},
+        "lock_witness": {"edges_observed": {
+            "FleetRouter._lock->CounterVec._lock": 10,
+        }, "committed_graph_present": True, "violations": [],
+            "observed_subgraph_of_committed": True},
+        "fault_taxonomy": {
+            "observed": {"RetrievalCandidatesExhaustedError->failed": 1,
+                         "RetrievalMissError->shed": 5},
+            "error_free_outcomes": {"served": 80},
+            "violations": [],
+            "committed_errors": 15, "committed_edges": 10,
+        },
+        "gc": {"frozen": True, "collections_during_run": [0, 0, 0]},
+        "obs_snapshot": {"obs_schema": 1, "metrics": {}, "collectors": {}},
+        "traces": {"sample_1_in": 8, "sampled": 12,
+                   "max_abs_residual_s": 0.0, "telescoping_exact": True,
+                   "exemplar_slow_traces": []},
+        "note": "canned",
+    }
+
+
+def test_city_main_emits_one_json_line_and_artifact(tmp_path, monkeypatch,
+                                                    capsys):
+    """The driver contract: ONE parseable JSON line, headline = recall@2
+    with the recall-by-K sweep, the accounting / bit-identity /
+    recompile acceptance fields surfaced, and the .city_retrieval.json
+    artifact with platform + recorded_at + obs provenance."""
+    monkeypatch.setattr(bench, "_CITY_FILE", tmp_path / "city.json")
+    monkeypatch.setattr(
+        bench, "measure_on_device",
+        lambda *a, **k: {"city": _canned_city(), "platform": "tpu",
+                         "device_kind": "fake-tpu"},
+    )
+    bench._city_main([], [0.0, 0.0, 0.0])
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1, f"expected ONE JSON line, got {len(lines)}"
+    out = json.loads(lines[0])
+    assert out["metric"] == "city_recall_at_2"
+    assert out["value"] == 0.8333
+    assert out["unit"] == "recall"
+    assert "vs_baseline" in out
+    assert out["recall_by_k"] == {"1": 0.7917, "2": 0.8333, "4": 0.875}
+    assert out["accounting_exact"] is True
+    assert out["breaker_bit_identical_restore"] is True
+    assert out["hot_path_recompiles"] == 0
+    assert out["min_confidence"] == 0.45
+    assert "contention" in out
+    artifact = json.loads((tmp_path / "city.json").read_text())
+    assert artifact["platform"] == "tpu"
+    assert "recorded_at" in artifact
+    assert artifact["obs_provenance"]["has_fleet_snapshot"] is True
+
+
+def test_city_cpu_fallback_carries_provenance(tmp_path, monkeypatch,
+                                              capsys):
+    """Relay wedged -> the city drill measures on CPU and SAYS so."""
+    monkeypatch.setattr(bench, "_CITY_FILE", tmp_path / "city.json")
+    monkeypatch.setattr(bench, "measure_on_device", lambda *a, **k: None)
+    monkeypatch.setattr(bench, "_measure_city",
+                        lambda *a, **k: _canned_city())
+    bench._city_main([], [0.0, 0.0, 0.0])
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1
+    out = json.loads(lines[0])
+    assert "CPU" in out["note"] or "cpu" in out["note"]
+    artifact = json.loads((tmp_path / "city.json").read_text())
+    assert artifact["platform"] == "cpu"
+    assert artifact["note"] == out["note"]
+
+
+def test_city_artifact_schema_committed():
+    """The committed .city_retrieval.json (when present) satisfies the
+    ISSUE 18 acceptance schema: recall@K for K in {1,2,4} with the
+    recall gradient measured on a real ambiguous-query mix, EXACT
+    image-tier accounting per leg (front books sum to offered, junk
+    included), the confident-query bit-identity pin, the breaker
+    fall-through + release_scene bit-identical restore, the typed
+    candidates-exhausted probe, zero hot-path recompiles across
+    enroll + every leg, and the lock/fault witnesses violation-free."""
+    import pathlib
+
+    path = pathlib.Path(bench.__file__).parent / ".city_retrieval.json"
+    if not path.exists():
+        import pytest
+
+        pytest.skip("no committed city artifact yet")
+    artifact = json.loads(path.read_text())
+    for key in ("metric", "value", "unit", "platform", "recorded_at",
+                "city"):
+        assert key in artifact, key
+    city = artifact["city"]
+    legs = {leg["top_k"]: leg for leg in city["legs"]}
+    assert sorted(legs) == [1, 2, 4]
+    n_loc = city["query_mix"]["easy"] + city["query_mix"]["hard"]
+    for k, leg in legs.items():
+        # Exact accounting, both tiers, junk queries included.
+        assert sum(leg["outcomes"].values()) == leg["offered"]
+        f = leg["front"]
+        assert (f["served"] + f["shed"] + f["expired"] + f["degraded"]
+                + f["failed"] + f["pending"] == f["offered"])
+        assert leg["accounting_exact"] is True
+        assert leg["fleet_accounting_exact"] is True
+        # recall@K is over ALL localizable queries (misses count
+        # against) and the fan-out can never exceed K.
+        assert 0.0 <= leg["recall_at_k"] <= 1.0
+        assert leg["recall_hits"] <= n_loc
+        # Confident-query bit-identity: image-path winner == the same
+        # frame dispatched with the winner's scene id.
+        assert leg["bit_identical"] is True
+    # Wider fan-out never retrieves less (K=1 <= K=2 <= K=4).
+    assert legs[1]["recall_at_k"] <= legs[2]["recall_at_k"] + 1e-9
+    assert legs[2]["recall_at_k"] <= legs[4]["recall_at_k"] + 1e-9
+    # The fleet is genuinely retrievable: recall@4 must beat chance by
+    # a wide margin (4/24 scenes ~ 0.17 at random).
+    assert legs[4]["recall_at_k"] >= 0.5
+    # Breaker fall-through + restore probe.
+    br = city["probes"]["breaker"]
+    assert br["tripped_excluded"] is True
+    assert br["tripped_skipped_delta"] >= 1
+    assert br["released_everywhere"] is True
+    assert br["bit_identical_restore"] is True
+    # Typed exhausted probe on a committed taxonomy edge.
+    ex = city["probes"]["exhausted"]
+    assert ex["raised"] is True
+    assert ex["type"] == "RetrievalCandidatesExhaustedError"
+    assert ex["retryable"] is True
+    # The no-recompile contract: enroll + three legs + probes never
+    # recompiled the retriever or a scene program.
+    assert city["compiled_programs"]["hot_path_recompiles"] == 0
+    # Posterior-driven prefetch fed every replica's prefetcher.
+    assert all(v >= 1 for v in city["posterior_prefetch_feeds"].values())
+    # Sampled image traces telescope exactly (retrieval root segment).
+    tr = city["traces"]
+    assert tr["sampled"] > 0 and tr["telescoping_exact"] is True
+    assert tr["max_abs_residual_s"] < 1e-6
+    # Runtime witnesses, violation-free against the committed graphs.
+    lw = city["lock_witness"]
+    assert lw["committed_graph_present"] is True
+    assert lw["violations"] == []
+    ft = city["fault_taxonomy"]
+    assert ft["violations"] == []
+    assert ft["committed_errors"] >= 15
+    assert city["gc"]["frozen"] is True

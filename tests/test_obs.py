@@ -1017,6 +1017,13 @@ def test_every_registered_collector_is_known_and_renders():
                                             slo=SLOPolicy()))],
         FleetPolicy(poll_ms=5.0), obs=disp.obs, start=False,
     )
+    from esac_tpu.retrieval import RetrievalFront, SceneIndex
+
+    # ISSUE 18: the image-tier front registers the "retrieval" collector
+    # through attach_retrieval (stats-only here — the forward fn is
+    # never invoked, so a stub keeps jax out of this test).
+    router.attach_retrieval(RetrievalFront(
+        lambda *a: None, None, SceneIndex(capacity=4, embed_dim=4)))
     snap = disp.obs.snapshot()
     registered = set(snap["collectors"])
     unknown = registered - set(KNOWN_COLLECTORS)

@@ -1,0 +1,465 @@
+"""Retrieval front-end tests: image-only requests at the fleet tier
+(ISSUE 18, DESIGN.md §22).
+
+The load-bearing claims:
+
+- the scene index enrolls/removes prototypes under a static max-scenes
+  axis, typed at its edges (ManifestError), and index mutations NEVER
+  recompile the jitted retriever forward (prototypes + mask are traced);
+- ``infer_image`` serves a confident query end to end — retrieval
+  posterior -> breaker-gated top-K -> routed expert dispatch -> winner
+  by soft-inlier score — and its accounting sums exactly to offered;
+- misses are TYPED and accounted by class: empty index, low-confidence
+  posterior, all-candidates-tripped (the RetrievalMissError family);
+- a breaker-tripped top-1 candidate is skipped (never dispatched) and
+  the runner-up backfills; ``release_scene`` restores top-1 routing
+  BIT-IDENTICALLY to the pre-trip answer;
+- every candidate dispatch failing converts to a typed
+  RetrievalCandidatesExhaustedError (outcome ``failed``), and the
+  observed (error, outcome) pairs stay inside the committed
+  ``.fault_taxonomy.json`` edges;
+- the posterior-prefetch seam feeds ``WeightPrefetcher.
+  observe_candidates`` with mass-weighted candidates and never raises;
+- the retrieval locks ride ``LockWitness.attach_fleet`` and the
+  observed acquisition order stays inside the committed lock graph.
+
+The fleet here is host-fake (echo-style infer fns, dummy checkpoint
+paths — no weights are ever loaded), so nearly the whole file is
+tier-1 cheap; one test compiles the REAL tiny retriever to pin the
+zero-recompile contract.
+"""
+
+import pathlib
+
+import numpy as np
+import pytest
+
+from esac_tpu.fleet import FleetPolicy, FleetRouter, Replica
+from esac_tpu.ransac import RansacConfig
+from esac_tpu.registry import SceneManifest, SceneRegistry
+from esac_tpu.registry.manifest import SceneEntry, ScenePreset
+from esac_tpu.retrieval import (
+    RetrievalCandidatesExhaustedError,
+    RetrievalFront,
+    RetrievalMissError,
+    RetrievalPolicy,
+    SceneIndex,
+)
+from esac_tpu.serve import (
+    FaultInjector,
+    MicroBatchDispatcher,
+    ShedError,
+    SLOPolicy,
+)
+from esac_tpu.serve.slo import ConfigError
+
+CFG = RansacConfig(n_hyps=8, refine_iters=2, frame_buckets=(1,),
+                   serve_max_wait_ms=0.0, serve_queue_depth=64)
+D = 4                      # fake embedding dim
+SCENES = ("a", "b", "c")   # one-hot prototypes along axes 0..2
+
+
+def _onehot(i):
+    v = np.zeros(D, np.float32)
+    v[i] = 1.0
+    return v
+
+_SCENE_VECS = {sid: _onehot(i) for i, sid in enumerate(SCENES)}
+
+
+def _query(sid, pure=1.0, other=None):
+    """A serve-shaped frame dict: the image leaf carries ``pure`` mass
+    on ``sid``'s axis (optionally split with ``other``) — axis 3
+    belongs to NO scene (the noise direction)."""
+    v = pure * _SCENE_VECS[sid]
+    if other is not None:
+        v = v + (1.0 - pure) * _SCENE_VECS[other]
+    return {"image": v.astype(np.float32)}
+
+
+def _noise_query():
+    v = np.zeros(D, np.float32)
+    v[3] = 1.0
+    return {"image": v}
+
+
+def _fake_retriever(params, protos, mask, images):
+    """Host mirror of make_retrieval_fn's product: normalized embedding,
+    masked cosine posterior at temperature 0.1."""
+    x = np.asarray(images, np.float32)
+    if x.ndim == 1:
+        x = x[None]
+    emb = x / np.maximum(
+        np.linalg.norm(x, axis=-1, keepdims=True), 1e-12)
+    logits = emb @ np.asarray(protos, np.float32).T / 0.1
+    logits = np.where(np.asarray(mask)[None, :], logits, -1e30)
+    z = logits - logits.max(axis=-1, keepdims=True)
+    p = np.exp(z)
+    return {"embedding": emb, "posterior": p / p.sum(-1, keepdims=True)}
+
+
+def _scene_infer(tree, scene=None, route_k=None):
+    """Deterministic per-scene expert fake: soft-inlier score is the
+    query's alignment with the dispatched scene's axis, so the GT scene
+    wins the cross-candidate argmax and reruns are bit-identical."""
+    x = np.asarray(tree["image"], np.float32)
+    s = x @ _SCENE_VECS[scene]
+    return {"scores": s[:, None],
+            "rvec": (x * 2.0 + ord(scene[0])).astype(np.float32),
+            "expert": np.zeros((x.shape[0],), np.int32)}
+
+
+def _index(scenes=SCENES, capacity=8):
+    idx = SceneIndex(capacity=capacity, embed_dim=D)
+    for sid in scenes:
+        idx.enroll(sid, _SCENE_VECS[sid][None])
+    return idx
+
+
+def _registry(scenes=SCENES):
+    m = SceneManifest()
+    preset = ScenePreset(height=16, width=16, num_experts=2, gated=False)
+    for sid in scenes:
+        m.add(SceneEntry(scene_id=sid, version=1,
+                         expert_ckpt=f"/ck_{sid}", preset=preset))
+    return SceneRegistry(m)
+
+
+def _image_fleet(n=2, policy=None, front_policy=None, start=True,
+                 with_registry=True, infer=_scene_infer):
+    slo = SLOPolicy(watchdog_ms=150.0, watchdog_poll_ms=10.0)
+    reps, injs = [], {}
+    for i in range(n):
+        name = f"r{i}"
+        inj = FaultInjector(infer, tag=name)
+        disp = MicroBatchDispatcher(inj, CFG, slo=slo,
+                                    start_worker=False)
+        reps.append(Replica(name, disp,
+                            registry=_registry() if with_registry
+                            else None))
+        injs[name] = inj
+    router = FleetRouter(reps, policy or FleetPolicy(poll_ms=2.0),
+                         start=False)
+    front = RetrievalFront(
+        _fake_retriever, None, _index(),
+        policy=front_policy or RetrievalPolicy(top_k=2),
+    )
+    router.attach_retrieval(front)
+    if start:
+        for rep in reps:
+            rep.dispatcher.start()
+        router.start()
+    return router, front, injs
+
+
+def _front_consistent(front):
+    s = front.stats()
+    assert (s["served"] + s["shed"] + s["expired"] + s["failed"]
+            + s["degraded"] + s["pending"] == s["offered"]), s
+    return s
+
+
+# ---------------- policy / index edges ----------------
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        RetrievalPolicy(top_k=0)
+    with pytest.raises(ValueError):
+        RetrievalPolicy(min_confidence=1.5)
+    with pytest.raises(ValueError):
+        RetrievalPolicy(prefetch_min_p=-0.1)
+    with pytest.raises(ValueError):
+        # top_k must fit the index's static axis.
+        RetrievalFront(_fake_retriever, None,
+                       SceneIndex(capacity=1, embed_dim=D),
+                       policy=RetrievalPolicy(top_k=2))
+
+
+def test_index_enroll_remove_typed_and_idempotent():
+    from esac_tpu.registry import ManifestError
+
+    idx = SceneIndex(capacity=2, embed_dim=D)
+    with pytest.raises(ValueError):
+        SceneIndex(capacity=0, embed_dim=D)
+    idx.enroll("a", _SCENE_VECS["a"][None])
+    with pytest.raises(ManifestError):
+        idx.enroll("z", np.zeros((1, D + 1), np.float32))  # dim mismatch
+    idx.enroll("b", _SCENE_VECS["b"][None])
+    with pytest.raises(ManifestError):
+        idx.enroll("c", _SCENE_VECS["c"][None])  # table full
+    # Re-enroll refreshes in place (no second slot).
+    idx.enroll("a", _SCENE_VECS["a"][None])
+    assert len(idx) == 2
+    assert idx.remove("a") is True
+    assert idx.remove("a") is False  # idempotent
+    assert len(idx) == 1
+    protos, mask, ids = idx.snapshot()
+    assert protos.shape == (2, D) and mask.sum() == 1
+    assert "b" in ids and "a" not in ids
+
+
+def test_real_retriever_no_recompile_across_index_mutations():
+    """The zero-recompile contract: prototypes and mask are TRACED
+    arguments of the one jitted forward, so enroll/remove/refresh never
+    grow the jit cache (one entry per batch shape, ever)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from esac_tpu.retrieval.model import (
+        RetrievalConfig,
+        build_retriever,
+        make_retrieval_fn,
+    )
+
+    cfg = RetrievalConfig(height=16, width=16, max_scenes=4, embed_dim=D,
+                          channels=(2,))
+    fn = make_retrieval_fn(cfg)
+    img = np.zeros((1, cfg.height, cfg.width, 3), np.float32)
+    params = build_retriever(cfg).init(jax.random.key(0), img)
+    idx = SceneIndex(capacity=cfg.max_scenes, embed_dim=cfg.embed_dim)
+
+    def posterior():
+        protos, mask, ids = idx.snapshot()
+        out = fn(params, protos, mask, img)
+        return np.asarray(out["posterior"][0]), ids
+
+    rng = np.random.RandomState(0)
+    emb = rng.rand(3, cfg.embed_dim).astype(np.float32)
+    idx.enroll("a", emb[:1])
+    p, _ = posterior()
+    baseline = fn._cache_size()
+    idx.enroll("b", emb[1:2])
+    idx.enroll("c", emb[2:])
+    p, ids = posterior()
+    assert fn._cache_size() == baseline, "enroll recompiled the forward"
+    # Masked slots carry exactly zero posterior mass.
+    empty = [i for i, sid in enumerate(ids) if sid is None]
+    assert float(p[empty].sum()) == 0.0
+    assert np.isclose(p.sum(), 1.0, atol=1e-5)
+    idx.remove("b")
+    p, _ = posterior()
+    assert fn._cache_size() == baseline, "remove recompiled the forward"
+
+
+# ---------------- the served path ----------------
+
+def test_image_request_serves_and_accounts_exactly():
+    router, front, _ = _image_fleet()
+    try:
+        out = router.infer_image(_query("a", 0.9, other="b"))
+        assert out["retrieval"]["scene"] == "a"
+        assert out["retrieval"]["top1"] == "a"
+        assert list(out["retrieval"]["candidates"]) == ["a", "b"]
+        assert "scores" in out and "rvec" in out
+        s = _front_consistent(front)
+        assert s["offered"] == s["served"] == 1
+        assert s["decided"] == 1 and s["pending"] == 0
+        assert s["winners_noted"] == 1 and s["top1_hits"] == 1
+        assert s["recall_proxy_top1"] == 1.0
+        assert s["candidate_fanout_mean"] == 2.0
+        # The per-candidate fleet books ride underneath untouched.
+        t = router.fleet_totals()
+        assert t["offered"] == 2 and t["served"] == 2
+    finally:
+        router.close(close_replicas=True)
+
+
+def test_image_requires_attached_front_and_rejects_double_attach():
+    router, front, _ = _image_fleet(start=False)
+    try:
+        with pytest.raises(ConfigError):
+            router.attach_retrieval(front)  # second attach is typed
+    finally:
+        router.close(close_replicas=True)
+    bare = FleetRouter(
+        [Replica("r0", MicroBatchDispatcher(
+            FaultInjector(_scene_infer, tag="r0"), CFG,
+            slo=SLOPolicy(), start_worker=False))],
+        FleetPolicy(poll_ms=2.0), start=False,
+    )
+    try:
+        with pytest.raises(ConfigError):
+            bare.infer_image(_query("a"))
+    finally:
+        bare.close(close_replicas=True)
+
+
+def test_misses_are_typed_shed_and_accounted_by_class():
+    router, front, _ = _image_fleet()
+    try:
+        # Low confidence: the noise axis matches nothing -> uniform
+        # posterior 1/3 < min_confidence 0.35.
+        with pytest.raises(RetrievalMissError) as ei:
+            router.infer_image(_noise_query())
+        assert ei.value.retryable is False
+        assert isinstance(ei.value, ShedError)
+        s = _front_consistent(front)
+        assert s["shed"] == 1 and s["missed_low_confidence"] == 1
+        assert s["error_types"] == {"RetrievalMissError": 1}
+        # No expert dispatch was spent on the miss.
+        assert router.fleet_totals()["offered"] == 0
+    finally:
+        router.close(close_replicas=True)
+    # Empty index: typed miss in its own class.
+    empty = RetrievalFront(_fake_retriever, None,
+                           SceneIndex(capacity=4, embed_dim=D))
+    with pytest.raises(RetrievalMissError):
+        empty.decide(_query("a"))
+    assert empty.stats()["missed_no_candidate"] == 1
+
+
+# ---------------- breaker gate / release_scene ----------------
+
+def _trip(router, sid, version=1):
+    for rep in router._replicas.values():
+        reg = rep.registry
+        with reg._health_lock:
+            reg._tripped[(sid, version)] = "test drill"
+
+
+def test_breaker_tripped_top1_falls_through_to_runner_up_then_restores():
+    import threading
+
+    from esac_tpu.lint.lockgraph import LOCK_GRAPH_NAME, load_graph
+    from esac_tpu.lint.witness import LockWitness
+
+    router, front, _ = _image_fleet(start=False)
+    witness = LockWitness()
+    witness.attach_fleet(router=router)
+    for rep in router._replicas.values():
+        rep.dispatcher.start()
+    router.start()
+    try:
+        q = _query("a", 0.8, other="b")
+        before = router.infer_image(q)
+        assert before["retrieval"]["scene"] == "a"
+        _trip(router, "a")
+        after = router.infer_image(q)
+        # Top-1 "a" is SKIPPED (never dispatched); "b" backfills and
+        # "c" pads the fan-out back to top_k.
+        assert after["retrieval"]["scene"] == "b"
+        assert "a" not in after["retrieval"]["candidates"]
+        assert list(after["retrieval"]["candidates"]) == ["b", "c"]
+        assert after["retrieval"]["top1"] == "a"  # health-agnostic
+        s = _front_consistent(front)
+        assert s["served"] == 2 and s["tripped_skipped"] == 1
+        # Operator release restores top-1 routing bit-identically.
+        for rep in router._replicas.values():
+            assert rep.registry.release_scene("a") is True
+        restored = router.infer_image(q)
+        assert restored["retrieval"] == before["retrieval"]
+        for key in ("scores", "rvec", "expert"):
+            assert np.array_equal(restored[key], before[key]), key
+        # All scenes tripped -> typed miss in the tripped class.
+        for sid in SCENES:
+            _trip(router, sid)
+        with pytest.raises(RetrievalMissError):
+            router.infer_image(q)
+        s = _front_consistent(front)
+        assert s["missed_tripped"] == 1 and s["shed"] == 1
+        assert s["served"] == 3 and s["pending"] == 0
+    finally:
+        router.close(close_replicas=True)
+    committed = load_graph(
+        pathlib.Path(__file__).resolve().parent.parent / LOCK_GRAPH_NAME
+    )
+    assert committed is not None
+    witness.assert_subgraph(committed)
+    # LEAF locks never appear in edges (nothing is held across them —
+    # that IS the claim); their hold histograms prove they were both
+    # witnessed and exercised.
+    held = set(witness.hold_summary())
+    assert any(n.startswith("RetrievalFront._lock") for n in held), held
+    assert any(n.startswith("SceneIndex._lock") for n in held), held
+    # And no edge ever NESTS another lock under them.
+    for src, _dst in witness.edges():
+        assert not src.startswith(("RetrievalFront._lock",
+                                   "SceneIndex._lock")), (src, _dst)
+    assert threading.active_count() < 50  # no leaked fleet threads
+
+
+def test_all_candidate_dispatches_failed_raises_exhausted_typed():
+    from esac_tpu.lint.witness import OutcomeWitness
+    from esac_tpu.registry.health import SceneLoadError
+
+    router, front, injs = _image_fleet()
+    ow = OutcomeWitness.from_repo(
+        pathlib.Path(__file__).resolve().parent.parent)
+    try:
+        # Every replica faults every candidate dispatch with a
+        # scene-level (non-failover) fault -> admission succeeds, the
+        # dispatch dies typed, and the image request converts to
+        # RetrievalCandidatesExhaustedError (outcome: failed).
+        for inj in injs.values():
+            inj.fail_times(SceneLoadError("drill: storage down"),
+                           times=8)
+        with pytest.raises(RetrievalCandidatesExhaustedError) as ei:
+            router.infer_image(_query("a", 0.9, other="b"))
+        assert ei.value.retryable is True
+        ow.observe(type(ei.value).__name__, "failed")
+        s = _front_consistent(front)
+        assert s["failed"] == 1 and s["decided"] == 1
+        assert s["error_types"] == \
+            {"RetrievalCandidatesExhaustedError": 1}
+        # The miss edge too: noise query -> (RetrievalMissError, shed).
+        with pytest.raises(RetrievalMissError) as ei2:
+            router.infer_image(_noise_query())
+        ow.observe(type(ei2.value).__name__, "shed")
+        ow.assert_consistent()
+    finally:
+        router.close(close_replicas=True)
+
+
+# ---------------- the prefetch seam ----------------
+
+def test_posterior_feeds_prefetcher_and_never_raises():
+    from esac_tpu.registry.prefetch import WeightPrefetcher
+
+    clock = [0.0]
+    pf = WeightPrefetcher(registry=None, clock=lambda: clock[0])
+    front = RetrievalFront(_fake_retriever, None, _index(),
+                           policy=RetrievalPolicy(top_k=2),
+                           prefetch_sinks=(pf.observe_candidates,))
+    # Genuinely ambiguous (temperature 0.1 sharpens hard — a 0.55/0.45
+    # split keeps the runner-up above the prefetch mass floor).
+    decision = front.decide(_query("a", 0.55, other="b"))
+    front.feed_prefetch(decision)
+    st = pf.stats()
+    assert st["posterior_feeds"] == 1
+    assert front.stats()["prefetch_feeds"] == 1
+    # The ambiguous runner-up rode the feed (mass >= prefetch_min_p);
+    # sub-floor scenes did not.
+    fed = {s for s, _t, _w in pf._arrivals}
+    assert {"a", "b"} <= fed and "c" not in fed
+    # A broken sink is counted, never raised through the request path.
+    def broken(weights):
+        raise RuntimeError("sink down")
+    front.add_prefetch_sink(broken)
+    front.feed_prefetch(decision)
+    assert front.stats()["feed_errors"] == 1
+    # Garbage into the arrival seam is swallowed by contract too.
+    pf.observe_candidates(None)
+    assert pf.stats()["feed_errors"] >= 1
+
+
+def test_router_wires_replica_prefetchers_as_sinks():
+    router, front, _ = _image_fleet(start=False)
+    try:
+        reg = next(iter(router._replicas.values())).registry
+        reg.attach_prefetcher(start=False)
+        front2 = RetrievalFront(_fake_retriever, None, _index())
+        r2 = FleetRouter(
+            [Replica("p0", MicroBatchDispatcher(
+                FaultInjector(_scene_infer, tag="p0"), CFG,
+                slo=SLOPolicy(), start_worker=False), registry=reg)],
+            FleetPolicy(poll_ms=2.0), start=False,
+        )
+        r2.attach_retrieval(front2)
+        try:
+            d = front2.decide(_query("a", 0.55, other="b"))
+            front2.feed_prefetch(d)
+            assert reg._prefetcher.stats()["posterior_feeds"] == 1
+        finally:
+            r2.close(close_replicas=True)
+    finally:
+        router.close(close_replicas=True)
