@@ -149,6 +149,20 @@ CITY_EASY = 16           # per-leg query mix: near-reference views ...
 CITY_HARD = 8            # ... heavy-noise ambiguous views ...
 CITY_JUNK = 6            # ... and out-of-fleet junk images
 
+SESSIONS_HW = 24         # tiny frames in the registry legs: the drill
+                         # measures the SESSION lane (parity, transitions,
+                         # accounting), not CNN throughput
+SESSIONS_M = 2           # experts per scene in the registry legs
+SESSIONS_FULL_HYPS = 64  # the scene's configured full budget
+SESSIONS_TRACK_HYPS = 8  # shrunken tracked budget (prewarmed override)
+SESSIONS_PRIOR_SLOTS = 4  # static prior-slot count P of the session lane
+SESSIONS_SEQ_FRAMES = 48  # continuous-trajectory sequence length
+SESSIONS_SEQ_FULL = 256  # coords-level full budget of the sequence legs
+SESSIONS_SEQ_TRACK = 32  # coords-level tracked budget (the >= 2x fps lever)
+SESSIONS_LOAD_SESSIONS = (2, 4, 8)  # concurrent sessions: the loadtest's
+                                    # unit of offered load
+SESSIONS_LOAD_FRAMES = 16           # frames streamed per session
+
 _REPO = pathlib.Path(__file__).resolve().parent
 _PROBE_FILE = _REPO / ".tpu_probe.json"
 _RESULT_FILE = _REPO / ".bench_device.json"
@@ -163,6 +177,7 @@ _PREFETCH_FILE = _REPO / ".weight_tiers.json"
 _FLEET_FILE = _REPO / ".fleet_serve.json"
 _HOSTPATH_FILE = _REPO / ".hostpath.json"
 _CITY_FILE = _REPO / ".city_retrieval.json"
+_SESSIONS_FILE = _REPO / ".session_serve.json"
 
 # ISSUE 17 committed baseline: .fleet_serve.json's per_replica_capacity_rps
 # as measured BEFORE the host hot-path overhaul (the number the >= 1.3x
@@ -2799,6 +2814,542 @@ def _measure_city_at(root: pathlib.Path, train_steps: int) -> dict:
     }
 
 
+def _measure_sessions() -> dict:
+    """Temporal-session serving drill (ISSUE 20, DESIGN.md §23): four
+    legs over the warm-start session lane.
+
+    1. PARITY + TRANSITIONS: one registry scene with the prior-slot
+       ladder prewarmed (``prewarm_programs(prior_slots=...)``); the
+       all-invalid prior program compared BIT-FOR-BIT against the plain
+       dense AND routed programs at the entry level and through a live
+       worker-backed dispatcher, then a tracked→lost→recovered flap
+       drill with the jit cache-miss counter pinning ZERO hot-path
+       recompiles, typed session-error probes, and the §19
+       ``session:track_loss`` trace event.
+    2. SEQUENCE THROUGHPUT: a continuous SyntheticScene trajectory
+       served coords-level through a SessionTable — tracked frames at
+       the shrunken budget with motion priors vs the full-budget
+       baseline; frames/s + pose accuracy per lane (the >= 2x at
+       matched accuracy acceptance).
+    3. RECOVERY: the same sequence with one mid-sequence corrupted
+       frame — track loss is typed/accounted and the NEXT frame's
+       full-budget fallback recovers pose accuracy within one frame.
+    4. SESSION LOADTEST: concurrent sessions as the unit of offered
+       load over the live dispatcher — exact session-level outcome
+       accounting per point, under the lock + outcome witnesses.
+    """
+    import shutil
+    import tempfile
+
+    root = pathlib.Path(tempfile.mkdtemp(prefix="esac_sessions_"))
+    try:
+        return _measure_sessions_at(root)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _measure_sessions_at(root: pathlib.Path) -> dict:
+    import collections
+    import dataclasses
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from esac_tpu.data import output_pixel_grid
+    from esac_tpu.data.datasets import SyntheticScene
+    from esac_tpu.geometry import pose_errors, rodrigues
+    from esac_tpu.lint.lockgraph import LOCK_GRAPH_NAME, load_graph
+    from esac_tpu.lint.witness import LockWitness, OutcomeWitness
+    from esac_tpu.models import ExpertNet, GatingNet
+    from esac_tpu.ransac import RansacConfig, esac_infer_prior
+    from esac_tpu.registry import (
+        SceneEntry, SceneManifest, ScenePreset, SceneRegistry,
+    )
+    from esac_tpu.serve import (
+        MIN_LANES, ServeError, SessionEvictedError, SessionPolicy,
+        SessionRouter, SessionUnknownError, ShedError, SLOPolicy,
+    )
+    from esac_tpu.utils.checkpoint import save_checkpoint
+
+    H = W = SESSIONS_HW
+    M = SESSIONS_M
+    P = SESSIONS_PRIOR_SLOTS
+    preset = ScenePreset(
+        height=H, width=W, num_experts=M,
+        stem_channels=(2, 4, 8), head_channels=8, head_depth=1,
+        gating_channels=(4,), compute_dtype="float32", gated=True,
+    )
+    cfg = RansacConfig(n_hyps=SESSIONS_FULL_HYPS, refine_iters=2,
+                       polish_iters=1, frame_buckets=(1,),
+                       serve_max_wait_ms=0.0, serve_queue_depth=64)
+
+    expert = ExpertNet(
+        scene_center=(0.0, 0.0, 0.0), stem_channels=preset.stem_channels,
+        head_channels=preset.head_channels, head_depth=preset.head_depth,
+        compute_dtype=jnp.float32,
+    )
+    gating = GatingNet(num_experts=M, channels=preset.gating_channels,
+                       compute_dtype=jnp.float32)
+    img0 = jnp.zeros((1, H, W, 3))
+    d = root / "scene0"
+    save_checkpoint(d / "expert", jax.vmap(lambda k: expert.init(k, img0))(
+        jax.random.split(jax.random.key(0), M)
+    ), {
+        "stem_channels": list(preset.stem_channels),
+        "head_channels": preset.head_channels,
+        "head_depth": preset.head_depth,
+        "scene_centers": [[0.0, 0.0, 2.0]] * M,
+        "f": 40.0, "c": [W / 2.0, H / 2.0],
+    })
+    save_checkpoint(d / "gating", gating.init(jax.random.key(1), img0),
+                    {"num_experts": M})
+    manifest = SceneManifest()
+    manifest.add(SceneEntry(
+        scene_id="scene0", version=1, expert_ckpt=str(d / "expert"),
+        gating_ckpt=str(d / "gating"), preset=preset, ransac=cfg,
+    ))
+    reg = SceneRegistry(manifest)
+
+    # Witness wiring BEFORE any traffic (attach-before-start contract):
+    # the session table is a committed LEAF lock — the loadtest's
+    # concurrent sessions must show no edge through it.
+    witness = LockWitness()
+    witness.attach_fleet(registry=reg)
+    outcome_witness = OutcomeWitness.from_repo(_REPO)
+
+    # The full session program ladder, off the hot path: {dense, routed}
+    # x {full budget, tracked override} x {plain, prior-slot sibling}.
+    compiled_prewarm = reg.prewarm_programs(
+        "scene0", frame_buckets=(1,), route_ks=(None, M),
+        n_hyps_overrides=(None, SESSIONS_TRACK_HYPS), prior_slots=P,
+    )
+
+    # ---- leg 1a: entry-level parity through the registry serve fn ----
+    serve = reg.infer_fn()
+    B = max(1, MIN_LANES)
+
+    def mk_plain(B=B):
+        # Fresh leaves per call: the bucket programs donate their batch
+        # on accelerators (R8).
+        return {
+            "key": jax.random.split(jax.random.key(11), B),
+            "image": jax.random.uniform(jax.random.key(5), (B, H, W, 3)),
+        }
+
+    def mk_prior(B=B):
+        b = mk_plain(B)
+        b["prior_rvec"] = jnp.zeros((B, P, 3))
+        b["prior_tvec"] = jnp.zeros((B, P, 3))
+        b["prior_valid"] = jnp.zeros((B, P), bool)
+        return b
+
+    entry_parity = {}
+    for label, rk in (("dense", None), (f"routed_k{M}", M)):
+        out_plain = jax.block_until_ready(serve(mk_plain(), "scene0",
+                                                route_k=rk))
+        out_prior = jax.block_until_ready(serve(mk_prior(), "scene0",
+                                                route_k=rk))
+        keys_cmp = [k for k in ("rvec", "tvec", "expert", "inlier_frac",
+                                "gating_probs", "scores")
+                    if k in out_plain and k in out_prior]
+        entry_parity[label] = {
+            "bitwise_equal": all(
+                np.array_equal(np.asarray(out_prior[k]),
+                               np.asarray(out_plain[k]))
+                for k in keys_cmp
+            ),
+            "keys_compared": keys_cmp,
+            "prior_hit_any": bool(np.asarray(out_prior["prior_hit"]).any()),
+        }
+
+    # ---- leg 1b: dispatcher-level parity + the flap drill ----
+    slo = SLOPolicy(deadline_ms=120_000.0, watchdog_ms=600_000.0)
+    disp = reg.dispatcher(cfg, slo=slo, trace=True, start_worker=False)
+    witness.attach_fleet(disp=disp)
+    disp.start()
+
+    def frame(i):
+        return {
+            "key": jax.random.fold_in(jax.random.key(7), i),
+            "image": np.asarray(jax.random.uniform(
+                jax.random.fold_in(jax.random.key(42), i % 4), (H, W, 3)
+            )),
+        }
+
+    # A never-tracking session: its frames ride the session lane (prior
+    # leaves attached, all-invalid) at the FULL budget — bitwise equal
+    # to the plain lane is the dispatcher-level parity pin.
+    cold_policy = SessionPolicy(
+        prior_slots=P, track_n_hyps=SESSIONS_TRACK_HYPS,
+        track_loss_frac=0.5, track_enter_frac=0.999, max_sessions=64,
+    )
+    cold = SessionRouter(disp, cold_policy)
+    cold.open("parity", scene="scene0", full_n_hyps=SESSIONS_FULL_HYPS)
+    out_direct = disp.infer_one(frame(0), scene="scene0")
+    out_session = cold.infer_frame("parity", frame(0))
+    disp_parity = all(
+        np.array_equal(np.asarray(out_session[k]), np.asarray(out_direct[k]))
+        for k in ("rvec", "tvec", "expert", "inlier_frac")
+    )
+    f_full = float(np.asarray(out_direct["inlier_frac"]))
+
+    # Flap policy: enter bar below the measured full-budget fraction,
+    # loss bar (almost surely) above the tracked-budget fraction — each
+    # full frame re-enters tracking, each tracked frame flaps to lost.
+    # That is a degenerate policy ON PURPOSE: it forces every
+    # tracked→lost→recovered transition through the live dispatcher so
+    # the recompile counter and the trace events see them all.  (The
+    # natural-policy behavior is leg 2's trajectory sequence.)
+    enter = max(min(f_full * 0.5, 0.999), 1e-9)
+    loss_bar = min(0.999, max(f_full * 2.0, 0.25))
+    flap_policy = SessionPolicy(
+        prior_slots=P, track_n_hyps=SESSIONS_TRACK_HYPS,
+        track_loss_frac=loss_bar, track_enter_frac=enter, max_sessions=64,
+    )
+    router = SessionRouter(disp, flap_policy)
+    witness.attach_fleet(session_router=router)
+    router.open("flap", scene="scene0", full_n_hyps=SESSIONS_FULL_HYPS)
+    seeded = False
+    if f_full <= 0.0:
+        # Degenerate probe (exact-zero soft-inlier mass): seed the
+        # tracked state directly so the flap drill still exercises the
+        # tracked-lane program + loss transition.
+        router.table.observe("flap", np.zeros(3, np.float32),
+                             np.zeros(3, np.float32), 1.0,
+                             was_tracked=False)
+        seeded = True
+    compiled_before_flap = reg.compile_cache_size()
+    transitions, tracked_flags = [], []
+    for i in range(8):
+        out = router.infer_frame("flap", frame(i))
+        transitions.append(out["session_transition"])
+        tracked_flags.append(bool(out["session_tracked"]))
+    compiled_after_flap = reg.compile_cache_size()
+    recovery_ok = all(
+        not tracked_flags[i + 1]
+        for i in range(len(transitions) - 1) if transitions[i] == "lost"
+    )
+
+    # ---- leg 1c: typed session errors + the track-loss trace event ----
+    typed_errors = {}
+    try:
+        router.infer_frame("never-opened", frame(0))
+    except SessionUnknownError as e:
+        typed_errors["unknown"] = {
+            "error": type(e).__name__, "wire_name": e.wire_name,
+            "retryable": e.retryable,
+        }
+    tiny = SessionRouter(disp, dataclasses.replace(flap_policy,
+                                                   max_sessions=1))
+    tiny.open("a", scene="scene0", full_n_hyps=SESSIONS_FULL_HYPS)
+    tiny.open("b", scene="scene0", full_n_hyps=SESSIONS_FULL_HYPS)
+    try:
+        tiny.infer_frame("a", frame(0))
+    except SessionEvictedError as e:
+        typed_errors["evicted"] = {
+            "error": type(e).__name__, "wire_name": e.wire_name,
+            "retryable": e.retryable,
+            "is_shed": isinstance(e, ShedError),
+        }
+        outcome_witness.observe("SessionEvictedError", "shed")
+    snap_a = disp.obs.snapshot()
+    # Count over the FULL retained ring, not the snapshot's 5-slowest
+    # window: tracked (lost) dispatches run the SHRUNKEN budget, so
+    # track-loss traces are the fast ones and rarely rank slowest.
+    loss_events = sum(
+        1
+        for t in disp._trace_store.traces()
+        for s in list(t.spans)
+        if s.name == "session:track_loss"
+    )
+    disp.close()
+
+    leg_parity = {
+        "prewarm_compiled_programs": compiled_prewarm,
+        "entry": entry_parity,
+        "dispatcher_bitwise": bool(disp_parity),
+        "probe_inlier_frac_full": f_full,
+        "flap_policy": {"enter_frac": enter, "loss_frac": loss_bar,
+                        "seeded_tracked": seeded},
+        "transitions": transitions,
+        "tracked_dispatches": tracked_flags,
+        "track_losses": int(router.table.track_losses),
+        "recovery_full_budget_next_frame": bool(recovery_ok),
+        "hot_path_recompiles": compiled_after_flap - compiled_prewarm,
+        "recompiles_during_flap": compiled_after_flap - compiled_before_flap,
+        "typed_errors": typed_errors,
+        "track_loss_trace_events": loss_events,
+    }
+
+    # ---- leg 2: continuous-trajectory sequence throughput ----
+    SH, SW, stride = 96, 128, 8
+    F = SESSIONS_SEQ_FRAMES
+    ds = SyntheticScene("synth0", split="trajectory", n_frames=F,
+                        height=SH, width=SW, coord_stride=stride)
+    pixels = output_pixel_grid(SH, SW, stride)
+    N = int(pixels.shape[0])
+    focal = jnp.float32(ds.focal)
+    center = jnp.asarray([SW / 2.0, SH / 2.0])
+    rng = np.random.default_rng(20)
+
+    def expert_coords(i, wrecked=False):
+        """Imperfect-expert model over the ground-truth scene geometry:
+        gaussian noise + shuffled-correspondence outliers (expert 0),
+        a fully shuffled junk map (expert 1).  ``wrecked`` shuffles
+        expert 0 too — the leg-3 mid-sequence corruption."""
+        gt = np.asarray(ds[i].coords_gt, np.float32).reshape(N, 3)
+        noisy = gt + rng.normal(0.0, 0.01, gt.shape).astype(np.float32)
+        mask = rng.random(N) < (1.0 if wrecked else 0.25)
+        noisy[mask] = gt[rng.permutation(N)][mask]
+        junk = gt[rng.permutation(N)] + \
+            rng.normal(0.0, 0.05, gt.shape).astype(np.float32)
+        return np.stack([noisy, junk])  # (M=2, N, 3)
+
+    coords_seq = [expert_coords(i) for i in range(F)]
+    logits = jnp.asarray([2.0, -2.0])
+    cfg_full = RansacConfig(n_hyps=SESSIONS_SEQ_FULL, refine_iters=4,
+                            polish_iters=2)
+    cfg_track = dataclasses.replace(cfg_full, n_hyps=SESSIONS_SEQ_TRACK)
+    seq_policy = SessionPolicy(
+        prior_slots=P, track_n_hyps=SESSIONS_SEQ_TRACK,
+        track_loss_frac=0.10, track_enter_frac=0.25, max_sessions=8,
+    )
+
+    def run_frame(i, coords, p_rv, p_tv, p_valid, cfg_i):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(esac_infer_prior(
+            jax.random.fold_in(jax.random.key(33), i), logits,
+            jnp.asarray(coords), pixels, focal, center,
+            jnp.asarray(p_rv), jnp.asarray(p_tv), jnp.asarray(p_valid),
+            cfg_i,
+        ))
+        dt = time.perf_counter() - t0
+        r_err, t_err = pose_errors(
+            rodrigues(out["rvec"]), out["tvec"],
+            rodrigues(jnp.asarray(ds[i].rvec)), jnp.asarray(ds[i].tvec),
+        )
+        return out, dt, float(r_err), float(t_err)
+
+    no_rv = np.zeros((P, 3), np.float32)
+    no_valid = np.zeros((P,), bool)
+    # Warm both static programs off the timed loops.
+    for cfg_w in (cfg_full, cfg_track):
+        run_frame(0, coords_seq[0], no_rv, no_rv, no_valid, cfg_w)
+
+    def session_pass(coords_by_frame):
+        from esac_tpu.serve import SessionTable
+
+        table = SessionTable(seq_policy)
+        table.open("seq", scene=None, full_n_hyps=SESSIONS_SEQ_FULL)
+        per = []
+        for i in range(F):
+            _, _, _, p_rv, p_tv, p_valid, tracked = table.plan("seq")
+            out, dt, r_err, t_err = run_frame(
+                i, coords_by_frame[i], p_rv, p_tv, p_valid,
+                cfg_track if tracked else cfg_full,
+            )
+            transition = table.observe(
+                "seq", np.asarray(out["rvec"]), np.asarray(out["tvec"]),
+                float(np.asarray(out["inlier_frac"])), tracked,
+            )
+            per.append({
+                "dt": dt, "tracked": tracked, "transition": transition,
+                "rot_deg": r_err, "trans_m": t_err,
+                "prior_hit": bool(np.asarray(out["prior_hit"])),
+            })
+        return per, table
+
+    def baseline_pass(coords_by_frame):
+        per = []
+        for i in range(F):
+            _, dt, r_err, t_err = run_frame(
+                i, coords_by_frame[i], no_rv, no_rv, no_valid, cfg_full,
+            )
+            per.append({"dt": dt, "rot_deg": r_err, "trans_m": t_err})
+        return per
+
+    def med(xs):
+        return float(np.median(xs)) if xs else None
+
+    base = baseline_pass(coords_seq)
+    sess, seq_table = session_pass(coords_seq)
+    t_idx = [i for i, p in enumerate(sess) if p["tracked"]]
+    tracked_ms = med([sess[i]["dt"] * 1e3 for i in t_idx])
+    full_ms = med([p["dt"] * 1e3 for p in base])
+    speedup = (full_ms / tracked_ms) if tracked_ms else None
+    rot_t, rot_f = med([sess[i]["rot_deg"] for i in t_idx]), \
+        med([base[i]["rot_deg"] for i in t_idx])
+    trans_t, trans_f = med([sess[i]["trans_m"] for i in t_idx]), \
+        med([base[i]["trans_m"] for i in t_idx])
+    accuracy_matched = (
+        t_idx != [] and rot_t <= rot_f + 0.5 and trans_t <= trans_f + 0.02
+    )
+    sequence = {
+        "frames": F, "n_cells": N,
+        "full_n_hyps": SESSIONS_SEQ_FULL,
+        "track_n_hyps": SESSIONS_SEQ_TRACK,
+        "prior_slots": P,
+        "tracked_frames": len(t_idx),
+        "tracked_frac": round(len(t_idx) / F, 4),
+        "prior_hit_frac_tracked": round(
+            float(np.mean([sess[i]["prior_hit"] for i in t_idx])), 4
+        ) if t_idx else None,
+        "tracked_ms_median": round(tracked_ms, 3) if tracked_ms else None,
+        "full_ms_median": round(full_ms, 3),
+        "tracked_fps": round(1e3 / tracked_ms, 2) if tracked_ms else None,
+        "full_fps": round(1e3 / full_ms, 2),
+        "tracked_speedup_x": round(speedup, 2) if speedup else None,
+        "pose_accuracy": {
+            "tracked_median_rot_deg": rot_t,
+            "full_median_rot_deg": rot_f,
+            "tracked_median_trans_m": trans_t,
+            "full_median_trans_m": trans_f,
+        },
+        "accuracy_matched": bool(accuracy_matched),
+        "budget_saved_hyps": seq_table.stats()["budget_saved_hyps"],
+        "transitions": [p["transition"] for p in sess],
+    }
+
+    # ---- leg 3: recovery-after-loss (mid-sequence corruption) ----
+    j = F // 2
+    coords_bad = list(coords_seq)
+    coords_bad[j] = expert_coords(j, wrecked=True)
+    wrecked, wreck_table = session_pass(coords_bad)
+    lost_at_j = wrecked[j]["transition"] == "lost"
+    fallback_full = not wrecked[j + 1]["tracked"]
+    recovered = (wrecked[j + 1]["rot_deg"] < 5.0
+                 and wrecked[j + 1]["trans_m"] < 0.05)
+    recovery = {
+        "corrupted_frame": j,
+        "tracked_at_corruption": bool(wrecked[j]["tracked"]),
+        "loss_transition_at_corruption": bool(lost_at_j),
+        "track_losses_accounted": wreck_table.stats()["track_losses"],
+        "fallback_full_budget_next_frame": bool(fallback_full),
+        "next_frame_rot_deg": wrecked[j + 1]["rot_deg"],
+        "next_frame_trans_m": wrecked[j + 1]["trans_m"],
+        "recovered_within_one_frame": bool(
+            lost_at_j and fallback_full and recovered
+        ),
+        "retracked_after_recovery": "tracked" in
+            [p["transition"] for p in wrecked[j + 1:]],
+    }
+
+    # ---- leg 4: sessions as the unit of offered load ----
+    load_enter = max(min(f_full * 0.5, 0.999), 1e-9)
+    load_policy = SessionPolicy(
+        prior_slots=P, track_n_hyps=SESSIONS_TRACK_HYPS,
+        track_loss_frac=1e-6, track_enter_frac=load_enter,
+        max_sessions=64,
+    )
+    points = []
+    for S in sorted(SESSIONS_LOAD_SESSIONS):
+        slo_l = SLOPolicy(deadline_ms=60_000.0, watchdog_ms=600_000.0)
+        disp_l = reg.dispatcher(cfg, slo=slo_l, start_worker=False)
+        router_l = SessionRouter(disp_l, load_policy)
+        witness.attach_fleet(disp=disp_l, session_router=router_l)
+        disp_l.start()
+        nF = SESSIONS_LOAD_FRAMES
+        counts = collections.Counter()
+        mu = threading.Lock()
+
+        def stream(sid):
+            for i in range(nF):
+                try:
+                    router_l.infer_frame(sid, frame(i), 60.0)
+                    with mu:
+                        counts["served"] += 1
+                except ServeError as e:  # typed outcome accounting
+                    with mu:
+                        counts[getattr(e, "wire_name",
+                                       type(e).__name__)] += 1
+
+        for s in range(S):
+            router_l.open(f"s{s}", scene="scene0",
+                          full_n_hyps=SESSIONS_FULL_HYPS)
+        threads = [threading.Thread(target=stream, args=(f"s{s}",),
+                                    daemon=True)
+                   for s in range(S)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600.0)
+        wall = time.perf_counter() - t0
+        stats = router_l.table.stats()
+        offered = S * nF
+        snap_l = disp_l.obs.snapshot()
+        disp_l.close()
+        points.append({
+            "sessions": S,
+            "frames_per_session": nF,
+            "offered": offered,
+            "outcomes": dict(counts),
+            "sums_to_offered": sum(counts.values()) == offered,
+            "wall_s": round(wall, 3),
+            "frames_per_s": round(offered / wall, 2),
+            "tracked_frac": stats["tracked_frac"],
+            "track_entries": stats["track_entries"],
+            "budget_saved_hyps": stats["budget_saved_hyps"],
+            "session_collector_rendered": "session" in
+                snap_l.get("collectors", {}),
+            "compiled_programs": reg.compile_cache_size(),
+        })
+    loadtest = {
+        "points": points,
+        "hot_path_recompiles":
+            points[-1]["compiled_programs"] - compiled_prewarm,
+    }
+
+    # ---- witnesses: observed lock order + fault flow vs committed ----
+    committed_graph = load_graph(_REPO / LOCK_GRAPH_NAME)
+    witness_snap = witness.snapshot()
+    violations = (witness.violations(committed_graph)
+                  if committed_graph is not None else None)
+    lock_witness = {
+        "edges_observed": witness_snap["edges"],
+        "committed_graph_present": committed_graph is not None,
+        "violations": violations,
+        "observed_subgraph_of_committed": (
+            violations == [] if violations is not None else None
+        ),
+        "session_lock_observed": any(
+            "SessionTable._lock" in str(k) for k in witness_snap["holds"]
+        ),
+    }
+    fault_taxonomy = outcome_witness.snapshot()
+    outcome_witness.assert_consistent()
+
+    return {
+        "prior_slots": P,
+        "scene": {"hw": [H, W], "num_experts": M,
+                  "full_n_hyps": SESSIONS_FULL_HYPS,
+                  "track_n_hyps": SESSIONS_TRACK_HYPS},
+        "parity": leg_parity,
+        "sequence": sequence,
+        "recovery": recovery,
+        "loadtest": loadtest,
+        "lock_witness": lock_witness,
+        "fault_taxonomy": fault_taxonomy,
+        "obs_snapshot": snap_a,
+        "note": (
+            "leg 1 pins the ISSUE-20 parity contract (all-invalid prior "
+            "mask bitwise == plain dense AND routed, entry-level and "
+            "through a live dispatcher) and zero hot-path recompiles "
+            "across tracked/lost/recovered flaps on an untrained "
+            "registry scene; leg 2 measures the warm-start lever on a "
+            "continuous trajectory at coords level (imperfect-expert "
+            "noise model; tiny scenes — the SPEEDUP RATIO is the "
+            "measurement, not absolute fps); leg 3 corrupts one "
+            "mid-sequence frame and requires full-budget recovery "
+            "within one frame; leg 4 streams concurrent sessions "
+            "closed-loop with exact typed outcome accounting under the "
+            "committed lock-graph and fault-taxonomy witnesses"
+        ),
+    }
+
+
 def _measure_hostpath(n_requests: int = HOSTPATH_REQUESTS) -> dict:
     """Host hot-path evidence leg (ISSUE 17, DESIGN.md §21): the
     stage-attributed host-overhead breakdown plus the before/after
@@ -3355,6 +3906,8 @@ def device_child(kwargs: dict) -> None:
         payload = {"hostpath": _measure_hostpath(**kwargs)}
     elif kwargs.pop("city", False):
         payload = {"city": _measure_city(**kwargs)}
+    elif kwargs.pop("sessions", False):
+        payload = {"sessions": _measure_sessions(**kwargs)}
     else:
         payload = {"rate": _measure_jax(**kwargs)}
     import jax
@@ -4002,6 +4555,42 @@ def _city_main(stopped: list[int], load_before: list[float]) -> None:
                  artifact_path=_CITY_FILE, headline=_city_headline)
 
 
+def _sessions_headline(sessions: dict) -> dict:
+    seq = sessions["sequence"]
+    par = sessions["parity"]
+    return {
+        "metric": "session_tracked_speedup_x",
+        "value": seq["tracked_speedup_x"],
+        "unit": "x",
+        "vs_baseline": None,
+        "tracked_frac": seq["tracked_frac"],
+        "accuracy_matched": seq["accuracy_matched"],
+        "parity_bitwise_entry": all(
+            leg["bitwise_equal"] for leg in par["entry"].values()
+        ),
+        "parity_bitwise_dispatcher": par["dispatcher_bitwise"],
+        "hot_path_recompiles": max(
+            par["hot_path_recompiles"],
+            sessions["loadtest"]["hot_path_recompiles"],
+        ),
+        "recovered_within_one_frame":
+            sessions["recovery"]["recovered_within_one_frame"],
+        "accounting_exact": all(p["sums_to_offered"]
+                                for p in sessions["loadtest"]["points"]),
+    }
+
+
+def _sessions_main(stopped: list[int], load_before: list[float]) -> None:
+    """``python bench.py sessions`` — the ISSUE 20 temporal-session
+    warm-start drill (DESIGN.md §23) through the shared wedge-safe
+    scaffold (.session_serve.json)."""
+    _driver_main(stopped, load_before, key="sessions",
+                 what="session serving drill",
+                 measure_cpu=lambda: _measure_sessions(),
+                 artifact_path=_SESSIONS_FILE,
+                 headline=_sessions_headline)
+
+
 def _obs_main(stopped: list[int], load_before: list[float]) -> None:
     """``python bench.py obs`` — the ISSUE 10 observability overhead gate
     (DESIGN.md §14) through the shared scaffold (.obs_overhead.json)."""
@@ -4047,6 +4636,7 @@ def _main_measured(stopped: list[int], load_before: list[float]) -> None:
         "fleet": _fleet_main,
         "hostpath": _hostpath_main,
         "city": _city_main,
+        "sessions": _sessions_main,
     }
     if len(sys.argv) > 1 and sys.argv[1] in modes:
         modes[sys.argv[1]](stopped, load_before)

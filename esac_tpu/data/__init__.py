@@ -14,6 +14,7 @@ from esac_tpu.data.synthetic import (
     output_pixel_grid,
     render_box_scene,
     random_poses_in_box,
+    trajectory_poses_in_box,
 )
 
 __all__ = [
@@ -23,4 +24,5 @@ __all__ = [
     "output_pixel_grid",
     "render_box_scene",
     "random_poses_in_box",
+    "trajectory_poses_in_box",
 ]

@@ -38,6 +38,7 @@ from esac_tpu.data.synthetic import (
     output_pixel_grid,
     random_poses_in_box,
     render_box_scene,
+    trajectory_poses_in_box,
 )
 from esac_tpu.geometry.rotations import so3_log
 
@@ -148,7 +149,15 @@ class SceneDataset:
 
 
 class SyntheticScene:
-    """Procedural box-room scene ``synthN`` with per-scene texture."""
+    """Procedural box-room scene ``synthN`` with per-scene texture.
+
+    Splits: ``training`` / ``test`` draw i.i.d. poses; ``trajectory``
+    (ISSUE 20) draws ONE smooth continuous camera path
+    (:func:`~esac_tpu.data.synthetic.trajectory_poses_in_box`) so
+    frame ``i+1`` is within a constant-velocity motion model of frame
+    ``i`` — the sequence substrate of the session-serving benches,
+    with per-frame ground truth and the same pre-staged-batch pattern.
+    """
 
     def __init__(self, scene: str = "synth0", split: str = "training",
                  n_frames: int = 64, height: int = 96, width: int = 128,
@@ -161,8 +170,10 @@ class SyntheticScene:
         self.expert = sid if expert is None else expert
         self.height, self.width, self.stride = height, width, coord_stride
         self.focal = CAMERA_F * width / 640.0
-        seed = sid * 1000 + (0 if split == "training" else 1)
-        self.rvecs, self.tvecs = random_poses_in_box(jax.random.key(seed), n_frames)
+        seed = sid * 1000 + {"training": 0, "trajectory": 2}.get(split, 1)
+        sampler = trajectory_poses_in_box if split == "trajectory" \
+            else random_poses_in_box
+        self.rvecs, self.tvecs = sampler(jax.random.key(seed), n_frames)
         # Pre-render EVERYTHING once, vmapped, and keep host copies: a jitted
         # render per __getitem__ costs a device dispatch each — through the
         # remote-TPU tunnel of this environment that is ~100ms per frame and

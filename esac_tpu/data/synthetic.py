@@ -61,6 +61,44 @@ def random_poses_in_box(key: jax.Array, n: int) -> tuple[jnp.ndarray, jnp.ndarra
     return rvecs, tvecs
 
 
+def trajectory_poses_in_box(
+    key: jax.Array,
+    n: int,
+    dt: float = 1.0 / 30.0,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """A smooth continuous camera trajectory through the room.
+
+    The temporal sibling of :func:`random_poses_in_box` (DESIGN.md §23):
+    the camera center and rotation each follow a sum of low-frequency
+    sinusoids with random per-axis phases, so consecutive frames at
+    ``dt`` spacing are within a constant-velocity motion model of each
+    other (the warm-start serving assumption) while the path still
+    covers the pose box over a long sequence.  Frame-to-frame deltas are
+    a few cm / a fraction of a degree at 30 Hz — real handheld-video
+    coherence, not i.i.d. redraws.
+
+    Returns (rvecs (n, 3), tvecs (n, 3)) in scene->camera convention,
+    same ranges as ``random_poses_in_box`` (centers inside
+    ``ROOM_SIZE * (0.5 +- 0.2)``, rotations within +-0.35 rad).
+    """
+    k1, k2 = jax.random.split(key)
+    t = jnp.arange(n, dtype=jnp.float32)[:, None] * dt  # (n, 1) seconds
+    # Two incommensurate frequencies per channel; amplitudes sum to the
+    # i.i.d. sampler's bounds so the path stays inside its pose box.
+    f1, f2 = 0.11, 0.047  # Hz — periods ~9s and ~21s
+    ph_c = jax.random.uniform(k1, (2, 3), maxval=2.0 * jnp.pi)
+    ph_r = jax.random.uniform(k2, (2, 3), maxval=2.0 * jnp.pi)
+    two_pi = 2.0 * jnp.pi
+    wiggle_c = 0.13 * jnp.sin(two_pi * f1 * t + ph_c[0]) \
+        + 0.07 * jnp.sin(two_pi * f2 * t + ph_c[1])      # (n, 3) in ±0.2
+    centers = ROOM_SIZE * (0.5 + wiggle_c)
+    rvecs = 0.23 * jnp.sin(two_pi * f1 * t + ph_r[0]) \
+        + 0.12 * jnp.sin(two_pi * f2 * t + ph_r[1])      # (n, 3) in ±0.35
+    Rs = rodrigues(rvecs)
+    tvecs = -jnp.einsum("nij,nj->ni", Rs, centers)
+    return rvecs, tvecs
+
+
 def _ray_box_depth(origin: jnp.ndarray, dirs: jnp.ndarray) -> jnp.ndarray:
     """Depth along each ray to the first box wall hit from inside.
 

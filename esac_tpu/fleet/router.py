@@ -230,15 +230,18 @@ class FleetRequest:
     unchanged.  The underlying per-replica request (``ureq``) changes
     across failovers; the fleet outcome is recorded exactly once."""
 
-    __slots__ = ("frame", "scene", "route_k", "deadline", "t_submit",
-                 "event", "result", "error", "outcome", "t_done", "done",
-                 "replica", "ureq", "attempts", "failover_from",
-                 "t_faulted", "owner", "_key", "trace", "_last_span")
+    __slots__ = ("frame", "scene", "route_k", "n_hyps", "deadline",
+                 "t_submit", "event", "result", "error", "outcome",
+                 "t_done", "done", "replica", "ureq", "attempts",
+                 "failover_from", "t_faulted", "owner", "_key", "trace",
+                 "_last_span")
 
-    def __init__(self, frame, scene, route_k, deadline, t_submit, owner):
+    def __init__(self, frame, scene, route_k, deadline, t_submit, owner,
+                 n_hyps=None):
         self.frame = frame
         self.scene = scene
         self.route_k = route_k
+        self.n_hyps = n_hyps       # per-dispatch budget override (ISSUE 20)
         self.deadline = deadline   # absolute clock() time, or None
         self.t_submit = t_submit
         self.event = threading.Event()
@@ -441,7 +444,8 @@ class FleetRouter:
     # ---------------- request path ----------------
 
     def submit(self, frame, scene=None, route_k=None,
-               deadline_ms: float | None = None) -> FleetRequest:
+               deadline_ms: float | None = None,
+               n_hyps: int | None = None) -> FleetRequest:
         """Route one request into the fleet; returns a
         :class:`FleetRequest` whose event fires at its (single) fleet
         outcome.  Raises typed at admission: a
@@ -449,11 +453,16 @@ class FleetRouter:
         healthy replica rejected it (or none is healthy —
         :class:`ReplicaQuarantinedError`), both counted shed;
         :class:`~esac_tpu.serve.slo.DeadlineExceededError` when the
-        deadline died during admission (counted expired)."""
+        deadline died during admission (counted expired).  ``n_hyps``
+        rides the per-dispatch hypothesis-budget override through to the
+        chosen replica's dispatcher (the session lane, ISSUE 20); scene
+        affinity is unchanged, so a session's shrunken-budget frames
+        land on the replica already holding its scene warm."""
         t_submit = self._clock()
         deadline = (t_submit + deadline_ms / 1e3
                     if deadline_ms is not None else None)
-        req = FleetRequest(frame, scene, route_k, deadline, t_submit, self)
+        req = FleetRequest(frame, scene, route_k, deadline, t_submit, self,
+                           n_hyps=n_hyps)
         route = None
         route_err = None
         with self._lock:
@@ -515,7 +524,8 @@ class FleetRouter:
 
     def infer_one(self, frame, scene=None, route_k=None,
                   timeout: float | None = None,
-                  deadline_ms: float | None = None):
+                  deadline_ms: float | None = None,
+                  n_hyps: int | None = None):
         """Blocking single-request inference through the fleet.  The
         bound is end-to-end: on timeout/deadline the request is
         abandoned (fleet outcome expired, late results discarded) and a
@@ -523,7 +533,8 @@ class FleetRouter:
         a replica is wedged."""
         if deadline_ms is None and timeout is not None:
             deadline_ms = timeout * 1e3
-        req = self.submit(frame, scene, route_k, deadline_ms)
+        req = self.submit(frame, scene, route_k, deadline_ms,
+                          n_hyps=n_hyps)
         limit = timeout
         if req.deadline is not None:
             # Remaining deadline + settle grace: the terminal event
@@ -759,7 +770,7 @@ RetrievalCandidatesExhaustedError` (failed — every candidate dispatch
                     kw["trace_ctx"] = req.trace
                 ureq = rep.dispatcher.submit(
                     req.frame, scene=req.scene, route_k=req.route_k,
-                    deadline_ms=remaining_ms, **kw,
+                    deadline_ms=remaining_ms, n_hyps=req.n_hyps, **kw,
                 )
             except (DispatcherClosedError, WorkerDiedError) as e:
                 # The replica itself is unroutable: breaker bookkeeping,

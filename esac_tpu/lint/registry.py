@@ -320,6 +320,67 @@ def _build_esac_infer_routed_frames():
     )(keys, coords_sel)
 
 
+def _build_esac_infer_frames_prior():
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.ransac.config import RansacConfig
+    from esac_tpu.ransac.esac import esac_infer_frames_prior
+
+    coords, pixels, f, c = _geom_inputs(_INFER_CELLS)
+    B, M, P = 2, 2, 3
+    cfg = RansacConfig(n_hyps=8, refine_iters=2, polish_iters=1,
+                       score_chunk=4)
+    keys = jax.random.split(jax.random.key(7), B)
+    coords_all = jnp.stack([coords, coords + 0.1])          # (M, N, 3)
+    coords_B = jnp.stack([coords_all, coords_all + 0.05])   # (B, M, N, 3)
+    logits_B = jnp.zeros((B, M))
+    pixels_B = jnp.stack([pixels, pixels])
+    f_B = jnp.stack([f, f])
+    # A mixed validity mask so both the masked prior scoring and the
+    # strict-> winner replacement are live in the traced program.
+    p_rv = jnp.zeros((B, P, 3))
+    p_tv = jnp.zeros((B, P, 3))
+    p_va = jnp.asarray([[True, True, False], [False, False, False]])
+    return jax.make_jaxpr(
+        lambda k, co: esac_infer_frames_prior(
+            k, logits_B, co, pixels_B, f_B, c, p_rv, p_tv, p_va, cfg
+        )
+    )(keys, coords_B)
+
+
+def _build_esac_infer_routed_frames_prior():
+    import jax
+    import jax.numpy as jnp
+
+    from esac_tpu.ransac.config import RansacConfig
+    from esac_tpu.ransac.esac import esac_infer_routed_frames_prior
+
+    coords, pixels, f, c = _geom_inputs(_INFER_CELLS)
+    B, M, K, P = 2, 4, 2, 3
+    cfg = RansacConfig(n_hyps=8, refine_iters=2, polish_iters=1,
+                       score_chunk=4)
+    keys = jax.random.split(jax.random.key(8), B)
+    coords_sel = jnp.stack([
+        jnp.stack([coords, coords + 0.1]),
+        jnp.stack([coords + 0.05, coords + 0.2]),
+    ])  # (B, K, N, 3)
+    logits_B = jnp.zeros((B, M))
+    selected = jnp.tile(jnp.asarray([1, 3], jnp.int32)[None], (B, 1))
+    kept = jnp.asarray([[True, True], [True, False]])
+    pixels_B = jnp.stack([pixels, pixels])
+    f_B = jnp.stack([f, f])
+    p_rv = jnp.zeros((B, P, 3))
+    p_tv = jnp.zeros((B, P, 3))
+    p_va = jnp.asarray([[True, True, False], [False, False, False]])
+    return jax.make_jaxpr(
+        lambda k, co: esac_infer_routed_frames_prior(
+            k, logits_B, co, selected, kept, pixels_B, f_B, c,
+            p_rv, p_tv, p_va, cfg
+        )
+    )(keys, coords_sel)
+
+
 def _build_routed_scene_serve():
     import jax
     import jax.numpy as jnp
@@ -560,6 +621,20 @@ ENTRIES: tuple[Entry, ...] = (
                "§11): gathered expert subsets, drop masking, reallocated "
                "budget — the RANSAC stage of the routed serve programs; "
                "pure geometry, so dot precision IS audited"),
+    Entry("esac_infer_frames_prior", pinned=True,
+          build=_build_esac_infer_frames_prior,
+          note="prior-slot sibling of esac_infer_frames (ISSUE 20): "
+               "frames-major dispatch with a static-count motion-prior "
+               "hypothesis slot entering as traced (pose, validity-mask) "
+               "arguments — tracked/cold/lost frames share ONE program; "
+               "pure geometry, so dot precision IS audited"),
+    Entry("esac_infer_routed_frames_prior", pinned=True,
+          build=_build_esac_infer_routed_frames_prior,
+          note="prior-slot sibling of esac_infer_routed_frames (ISSUE "
+               "20): capacity-routed hypothesis loop with the session "
+               "prior slot scored against every live gathered expert "
+               "under the same masked -inf/strict-> tie-break parity "
+               "contract; pure geometry, so dot precision IS audited"),
     Entry("routed_scene_serve", pinned=False,
           build=_build_routed_scene_serve,
           note="gating-first routed bucket program (esac_tpu.registry, "
@@ -619,6 +694,10 @@ R11_WAIVED: dict[str, str] = {
     ),
     "esac_infer_topk": (
         "per-frame core of esac_infer_topk_frames (registered): identical "
+        "primitives modulo the frame vmap axis"
+    ),
+    "esac_infer_prior": (
+        "per-frame core of esac_infer_frames_prior (registered): identical "
         "primitives modulo the frame vmap axis"
     ),
     "sample_correspondence_sets": (

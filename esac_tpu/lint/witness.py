@@ -161,7 +161,8 @@ class LockWitness:
         return self
 
     def attach_fleet(self, disp=None, registry=None, injector=None,
-                     prefetcher=None, router=None) -> "LockWitness":
+                     prefetcher=None, router=None,
+                     session_router=None) -> "LockWitness":
         """One-call wiring for the shipped fleet shapes: a
         MicroBatchDispatcher (lock + conditions + its obs instruments),
         a SceneRegistry (health/program locks, manifest, weight cache +
@@ -223,6 +224,11 @@ class LockWitness:
                 idx = getattr(front, "_index", None)
                 if idx is not None and hasattr(idx, "_lock"):
                     self.attach(idx, "_lock")
+        if session_router is not None:
+            # ISSUE 20: the session table is a committed LEAF lock —
+            # plan/observe snapshot under it, every dispatch and result
+            # wait happens outside (R13), so no edge may ever appear.
+            self.attach(session_router.table, "_lock")
         return self
 
     @staticmethod

@@ -51,6 +51,11 @@ KNOWN_COLLECTORS = {
                   "candidate_fanout_mean", "winners_noted", "top1_hits",
                   "winner_in_topk", "recall_proxy_top1",
                   "prefetch_feeds", "enrolled"),
+    # temporal sessions (ISSUE 20): warm-start lane accounting
+    "session": ("sessions", "opened", "closed", "evicted", "frames",
+                "tracked_frames", "full_frames", "tracked_frac",
+                "track_losses", "track_entries", "budget_saved_hyps",
+                "dispatch_errors"),
     # runtime lock witness (graft-audit v3; test/bench attach only)
     "lock_witness": (),
     # runtime outcome witness (graft-audit v5; test/bench attach only)

@@ -21,7 +21,10 @@ from esac_tpu.ransac.kernel import (
 from esac_tpu.ransac.esac import (
     esac_infer,
     esac_infer_frames,
+    esac_infer_frames_prior,
+    esac_infer_prior,
     esac_infer_routed_frames,
+    esac_infer_routed_frames_prior,
     esac_infer_topk,
     esac_infer_topk_frames,
     esac_train_loss,
@@ -41,7 +44,10 @@ __all__ = [
     "dsac_train_loss",
     "esac_infer",
     "esac_infer_frames",
+    "esac_infer_frames_prior",
+    "esac_infer_prior",
     "esac_infer_routed_frames",
+    "esac_infer_routed_frames_prior",
     "esac_infer_topk",
     "esac_infer_topk_frames",
     "esac_train_loss",

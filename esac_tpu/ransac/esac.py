@@ -188,6 +188,122 @@ def esac_infer_frames(
     )(keys, gating_logits, coords_all, pixels, f)
 
 
+def _prior_slot_winner(k_sub, prior_rvecs, prior_tvecs, prior_valid,
+                       coords, pixels, f, c, cfg):
+    """Best of the P motion-prior candidate poses on ONE expert's
+    coordinate map (ISSUE 20, DESIGN.md §23): the priors score through
+    the SAME ``_score_hypotheses`` math as the sampled stream — same
+    ``k_sub`` subsample cells, same scale — so a prior's score is
+    directly comparable with ``_infer_winner``'s streamed best.  Invalid
+    slots mask to ``-inf``; returns ``(pj, ps)``, the winning prior
+    index and its masked score (``-inf`` when every slot is invalid).
+    """
+    scores = _score_hypotheses(
+        k_sub, prior_rvecs, prior_tvecs, coords, pixels, f, c, cfg
+    )
+    masked = jnp.where(prior_valid, scores, -jnp.inf)
+    pj = jnp.argmax(masked)
+    return pj, masked[pj]
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def esac_infer_prior(
+    key: jax.Array,
+    gating_logits: jnp.ndarray,
+    coords_all: jnp.ndarray,
+    pixels: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    prior_rvecs: jnp.ndarray,
+    prior_tvecs: jnp.ndarray,
+    prior_valid: jnp.ndarray,
+    cfg: RansacConfig = RansacConfig(),
+) -> dict:
+    """``esac_infer`` with a static-count prior-hypothesis slot
+    (ISSUE 20): ``prior_rvecs``/``prior_tvecs`` (P, 3) are motion-model
+    propagated candidate poses entering as TRACED arguments with a
+    ``prior_valid`` (P,) mask, so tracked, cold and lost-track frames
+    all share one compiled program.
+
+    The sampled stream is byte-for-byte ``esac_infer``'s (same
+    hypothesis and subsample RNG keys, same streamed selection); the P
+    priors are scored per expert on that expert's own map and appended
+    AFTER the sampled slots in the conceptual flat order — a prior
+    replaces an expert's streamed winner only on a STRICTLY greater
+    score, and ``jnp.argmax`` across experts keeps the first expert, so
+    tie-breaking matches the flat argmax over [sampled..., priors...].
+    With an all-invalid mask every prior scores ``-inf``, selection and
+    the refine inputs coincide exactly with ``esac_infer``'s, and the
+    outputs are bit-identical (the DESIGN.md §23 parity pin, same
+    cross-program precedent as the routed K=M pin).
+
+    Extra outputs: ``prior_hit`` (did a prior win selection) and
+    ``prior_slot`` (winning prior index, or P when the sampled stream
+    won).
+    """
+    P = prior_rvecs.shape[0]
+    k_hyp, k_sub = _split_score_key(key, cfg)
+    rvecs, tvecs, best_j, best_s, scores = _per_expert_winners(
+        k_hyp, coords_all, pixels, f, c, cfg, score_key=k_sub
+    )
+    p_j, p_s = jax.vmap(
+        lambda co: _prior_slot_winner(
+            k_sub, prior_rvecs, prior_tvecs, prior_valid, co, pixels, f, c,
+            cfg,
+        )
+    )(coords_all)                      # (M,), (M,)
+    is_prior = p_s > best_s            # strict: sampled slots come first
+    ext_s = jnp.where(is_prior, p_s, best_s)
+    m_star = jnp.argmax(ext_s)
+    j_star = best_j[m_star]
+    hit = is_prior[m_star]
+    rv0 = jnp.where(hit, prior_rvecs[p_j[m_star]], rvecs[m_star, j_star])
+    tv0 = jnp.where(hit, prior_tvecs[p_j[m_star]], tvecs[m_star, j_star])
+    rvec, tvec = refine_soft_inliers(
+        rv0, tv0, coords_all[m_star], pixels, f, c, cfg.tau, cfg.beta,
+        iters=cfg.refine_iters,
+    )
+    out = {
+        "rvec": rvec,
+        "tvec": tvec,
+        "expert": m_star,
+        "gating_probs": jax.nn.softmax(gating_logits),
+        "inlier_frac": ext_s[m_star] / pixels.shape[0],
+        "prior_hit": hit,
+        "prior_slot": jnp.where(hit, p_j[m_star], P).astype(jnp.int32),
+    }
+    if scores is None:
+        out["score"] = ext_s[m_star]
+    else:
+        out["scores"] = scores
+    return out
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def esac_infer_frames_prior(
+    keys: jax.Array,
+    gating_logits: jnp.ndarray,
+    coords_all: jnp.ndarray,
+    pixels: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    prior_rvecs: jnp.ndarray,
+    prior_tvecs: jnp.ndarray,
+    prior_valid: jnp.ndarray,
+    cfg: RansacConfig = RansacConfig(),
+) -> dict:
+    """Frames-major :func:`esac_infer_prior`: B frames, each with its own
+    (P, 3) prior-pose slate and (P,) validity mask, in ONE dispatch —
+    the session-serving sibling of :func:`esac_infer_frames` (shapes as
+    there, priors gaining a leading (B,) axis)."""
+    return jax.vmap(
+        lambda k, g, ca, px, fi, pr, pt, pv: esac_infer_prior(
+            k, g, ca, px, fi, c, pr, pt, pv, cfg
+        )
+    )(keys, gating_logits, coords_all, pixels, f,
+      prior_rvecs, prior_tvecs, prior_valid)
+
+
 def _expected_losses_per_expert(rvecs, tvecs, scores, coords_all, pixels, f, c, R_gt, t_gt, cfg):
     """Within-expert softmax-selection expectation of the refined pose loss.
 
@@ -310,11 +426,39 @@ def routed_serve_capacity(cfg: RansacConfig, k: int, num_experts: int) -> int:
     return max(2, min(cap, big))
 
 
+def _routed_frame_candidates(key, co_sel, sel, live, px, fi, c, cfg_k, M):
+    """Candidate stage of the capacity-routed hypothesis loop: global-index
+    RNG streams, generate + STREAMED score+select over the K gathered
+    expert maps (``kernel._infer_winner`` per slot), ``-inf`` masking of
+    non-live slots at the slot level.
+
+    Shared VERBATIM by :func:`_routed_frame_winner` (hence
+    :func:`esac_infer_routed_frames` and
+    ``parallel.make_esac_infer_routed_frames_sharded``) and
+    :func:`_routed_frame_winner_prior`, so the sampled candidate stream —
+    (expert, key) pairs, scores, masking — is structurally identical
+    across all routed entries.  Returns
+    ``(k_sub, rvecs, tvecs, best_j, best_s, scores)`` with ``best_s``
+    live-masked and ``scores`` the masked (K, nh) matrix (None under
+    scoring_impl="fused_select").
+    """
+    k_hyp, k_sub = _split_score_key(key, cfg_k)
+    keys_sel = jax.random.split(k_hyp, M)[sel]  # global-index streams
+    rvecs, tvecs = jax.vmap(
+        lambda kk, co: generate_hypotheses(kk, co, px, fi, c, cfg_k)
+    )(keys_sel, co_sel)
+    best_j, best_s, scores = jax.vmap(
+        lambda rv, tv, co: _infer_winner(k_sub, rv, tv, co, px, fi, c, cfg_k)
+    )(rvecs, tvecs, co_sel)
+    best_s = jnp.where(live, best_s, -jnp.inf)
+    if scores is not None:
+        scores = jnp.where(live[:, None], scores, -jnp.inf)
+    return k_sub, rvecs, tvecs, best_j, best_s, scores
+
+
 def _routed_frame_winner(key, co_sel, sel, live, px, fi, c, cfg_k, M):
-    """One frame of the capacity-routed hypothesis loop: global-index RNG
-    streams, generate + STREAMED score+select over the K gathered expert
-    maps (``kernel._infer_winner`` per slot), ``-inf`` masking of non-live
-    slots at the slot level, winner-only refine.
+    """One frame of the capacity-routed hypothesis loop:
+    :func:`_routed_frame_candidates` + winner-only refine.
 
     Shared VERBATIM by :func:`esac_infer_routed_frames` and
     ``parallel.make_esac_infer_routed_frames_sharded`` so their bit-level
@@ -330,17 +474,9 @@ def _routed_frame_winner(key, co_sel, sel, live, px, fi, c, cfg_k, M):
     a frame whose every slot dropped resolves to (mi=0, j=0) exactly as
     ``argmax`` over an all ``-inf`` matrix does.
     """
-    k_hyp, k_sub = _split_score_key(key, cfg_k)
-    keys_sel = jax.random.split(k_hyp, M)[sel]  # global-index streams
-    rvecs, tvecs = jax.vmap(
-        lambda kk, co: generate_hypotheses(kk, co, px, fi, c, cfg_k)
-    )(keys_sel, co_sel)
-    best_j, best_s, scores = jax.vmap(
-        lambda rv, tv, co: _infer_winner(k_sub, rv, tv, co, px, fi, c, cfg_k)
-    )(rvecs, tvecs, co_sel)
-    best_s = jnp.where(live, best_s, -jnp.inf)
-    if scores is not None:
-        scores = jnp.where(live[:, None], scores, -jnp.inf)
+    _, rvecs, tvecs, best_j, best_s, scores = _routed_frame_candidates(
+        key, co_sel, sel, live, px, fi, c, cfg_k, M
+    )
     mi = jnp.argmax(best_s)
     # All-dropped frame: every masked winner is -inf and argmax lands on
     # slot 0; pin j to 0 to match the flat-argmax failure output.
@@ -350,6 +486,44 @@ def _routed_frame_winner(key, co_sel, sel, live, px, fi, c, cfg_k, M):
         cfg_k.tau, cfg_k.beta, iters=cfg_k.refine_iters,
     )
     return rvec, tvec, scores, mi, best_s[mi]
+
+
+def _routed_frame_winner_prior(key, co_sel, sel, live, px, fi, c, cfg_k, M,
+                               prior_rvecs, prior_tvecs, prior_valid):
+    """:func:`_routed_frame_winner` with the static-count prior slot
+    (ISSUE 20): the P motion-prior poses are scored on each LIVE slot's
+    gathered coordinate map through the same ``k_sub`` subsample as the
+    sampled stream, masked by validity AND slot liveness, and a prior
+    replaces a slot's streamed winner only on a STRICTLY greater score —
+    so with an all-invalid mask selection, the failure pin (mi=0, j=0)
+    and the refine inputs coincide exactly with
+    :func:`_routed_frame_winner` (the DESIGN.md §23 parity pin).
+
+    Returns ``(rvec, tvec, scores, mi, best, hit, pj)`` — the winner
+    tuple plus whether a prior won and which slot it came from.
+    """
+    k_sub, rvecs, tvecs, best_j, best_s, scores = _routed_frame_candidates(
+        key, co_sel, sel, live, px, fi, c, cfg_k, M
+    )
+    p_j, p_s = jax.vmap(
+        lambda co: _prior_slot_winner(
+            k_sub, prior_rvecs, prior_tvecs, prior_valid, co, px, fi, c,
+            cfg_k,
+        )
+    )(co_sel)                           # (K,), (K,)
+    p_s = jnp.where(live, p_s, -jnp.inf)
+    is_prior = p_s > best_s             # strict: sampled slots come first
+    ext_s = jnp.where(is_prior, p_s, best_s)
+    mi = jnp.argmax(ext_s)
+    hit = is_prior[mi]
+    j = jnp.where(live[mi], best_j[mi], 0)
+    rv0 = jnp.where(hit, prior_rvecs[p_j[mi]], rvecs[mi, j])
+    tv0 = jnp.where(hit, prior_tvecs[p_j[mi]], tvecs[mi, j])
+    rvec, tvec = refine_soft_inliers(
+        rv0, tv0, co_sel[mi], px, fi, c,
+        cfg_k.tau, cfg_k.beta, iters=cfg_k.refine_iters,
+    )
+    return rvec, tvec, scores, mi, ext_s[mi], hit, p_j[mi]
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -425,6 +599,69 @@ def esac_infer_routed_frames(
 
     return jax.vmap(one_frame)(
         keys, gating_logits, coords_sel, selected, kept, pixels, f
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def esac_infer_routed_frames_prior(
+    keys: jax.Array,
+    gating_logits: jnp.ndarray,
+    coords_sel: jnp.ndarray,
+    selected: jnp.ndarray,
+    kept: jnp.ndarray,
+    pixels: jnp.ndarray,
+    f: jnp.ndarray,
+    c: jnp.ndarray,
+    prior_rvecs: jnp.ndarray,
+    prior_tvecs: jnp.ndarray,
+    prior_valid: jnp.ndarray,
+    cfg: RansacConfig = RansacConfig(),
+) -> dict:
+    """:func:`esac_infer_routed_frames` with a static-count
+    prior-hypothesis slot (ISSUE 20, DESIGN.md §23): per-frame (P, 3)
+    motion-prior pose slates with a (P,) validity mask enter as traced
+    arguments, so tracked / cold / lost-track frames share ONE compiled
+    program per (bucket, K, n_hyps).  Budget reallocation, drop masking
+    and the ``experts_evaluated`` accounting contract are inherited
+    verbatim (the sampled candidate stage is
+    :func:`_routed_frame_candidates`, shared with the non-prior entry);
+    with an all-invalid mask the outputs are bit-identical to
+    :func:`esac_infer_routed_frames`.
+
+    Extra outputs per frame: ``prior_hit`` and ``prior_slot`` (winning
+    prior index, or P when the sampled stream won).
+    """
+    import dataclasses
+
+    M = gating_logits.shape[-1]
+    K = selected.shape[-1]
+    P = prior_rvecs.shape[-2]
+    nh = max(1, (cfg.n_hyps * M) // K)
+    cfg_k = dataclasses.replace(cfg, n_hyps=nh)
+
+    def one_frame(key, logits, co_sel, sel, kp, px, fi, p_rv, p_tv, p_va):
+        rvec, tvec, scores, mi, best, hit, pj = _routed_frame_winner_prior(
+            key, co_sel, sel, kp, px, fi, c, cfg_k, M, p_rv, p_tv, p_va
+        )
+        out = {
+            "rvec": rvec,
+            "tvec": tvec,
+            "expert": sel[mi],
+            "experts_evaluated": jnp.where(kp, sel, M).astype(jnp.int32),
+            "gating_probs": jax.nn.softmax(logits),
+            "inlier_frac": best / px.shape[0],
+            "prior_hit": hit,
+            "prior_slot": jnp.where(hit, pj, P).astype(jnp.int32),
+        }
+        if scores is None:
+            out["score"] = best
+        else:
+            out["scores"] = scores
+        return out
+
+    return jax.vmap(one_frame)(
+        keys, gating_logits, coords_sel, selected, kept, pixels, f,
+        prior_rvecs, prior_tvecs, prior_valid
     )
 
 

@@ -245,7 +245,7 @@ def make_scene_bucket_fn(preset: ScenePreset, cfg: RansacConfig):
     from esac_tpu.data.synthetic import output_pixel_grid
     from esac_tpu.models.expert import ExpertNet
     from esac_tpu.models.gating import GatingNet
-    from esac_tpu.ransac.esac import esac_infer_frames
+    from esac_tpu.ransac.esac import esac_infer_frames, esac_infer_frames_prior
 
     dtype = jnp.bfloat16 if preset.compute_dtype == "bfloat16" else jnp.float32
     expert = ExpertNet(
@@ -278,6 +278,17 @@ def make_scene_bucket_fn(preset: ScenePreset, cfg: RansacConfig):
             jnp.asarray(params["f"], jnp.float32), (B,)
         )
         px_b = jnp.broadcast_to(pixels[None], (B,) + pixels.shape)
+        if "prior_rvec" in batch:
+            # Session lane (ISSUE 20): the presence of the prior leaves is
+            # a STATIC property of the batch tree structure, so the one
+            # Jit wrapper holds two programs per bucket — plain and
+            # prior-slot — and the validity mask (not the tree shape)
+            # carries the tracked/cold/lost distinction at zero recompiles.
+            return esac_infer_frames_prior(
+                batch["key"], logits, coords, px_b, f_b, params["c"],
+                batch["prior_rvec"], batch["prior_tvec"],
+                batch["prior_valid"], cfg,
+            )
         return esac_infer_frames(
             batch["key"], logits, coords, px_b, f_b, params["c"], cfg
         )
@@ -327,6 +338,7 @@ def make_routed_scene_bucket_fn(preset: ScenePreset, cfg: RansacConfig,
     from esac_tpu.parallel.esac_sharded import route_frames_to_experts
     from esac_tpu.ransac.esac import (
         esac_infer_routed_frames,
+        esac_infer_routed_frames_prior,
         routed_serve_capacity,
         select_topk_experts,
     )
@@ -394,6 +406,14 @@ def make_routed_scene_bucket_fn(preset: ScenePreset, cfg: RansacConfig,
             jnp.asarray(params["f"], jnp.float32), (B,)
         )
         px_b = jnp.broadcast_to(pixels[None], (B,) + pixels.shape)
+        if "prior_rvec" in batch:
+            # Session lane: static tree-structure branch, two programs per
+            # Jit wrapper (see make_scene_bucket_fn).
+            return esac_infer_routed_frames_prior(
+                batch["key"], logits, coords_sel, selected, kept, px_b,
+                f_b, params["c"], batch["prior_rvec"],
+                batch["prior_tvec"], batch["prior_valid"], cfg,
+            )
         return esac_infer_routed_frames(
             batch["key"], logits, coords_sel, selected, kept, px_b, f_b,
             params["c"], cfg,
@@ -1073,7 +1093,8 @@ class SceneRegistry:
         self.cache.get(self.manifest.resolve(scene_id))
 
     def prewarm_programs(self, scene_id: str, frame_buckets,
-                         route_ks=(None,), n_hyps_overrides=(None,)) -> int:
+                         route_ks=(None,), n_hyps_overrides=(None,),
+                         prior_slots: int = 0) -> int:
         """Compile (and run once, on zero frames) every (K, frame-bucket)
         program a scene's traffic — including an SLO degradation ladder
         (serve.slo.SLOPolicy.degrade_route_k) — can reach, OFF the hot
@@ -1081,7 +1102,12 @@ class SceneRegistry:
         already-compiled static program (DESIGN.md §12); prewarming is
         what makes even the *first* degraded dispatch recompile-free.
         ``n_hyps_overrides`` prewarms hypothesis-budget override programs
-        too (see :meth:`_fn_for`).
+        too (see :meth:`_fn_for`), and ``prior_slots > 0`` ADDITIONALLY
+        prewarms each combination's prior-slot sibling program (ISSUE 20:
+        batch trees carrying ``prior_rvec``/``prior_tvec``/``prior_valid``
+        leaves with P = ``prior_slots``) — the session serving lane's
+        tracked→lost→recovered transitions then never compile on the hot
+        path.
         Returns the compiled-program count afterwards (the jit cache-miss
         counter tests pin across degrade events)."""
         import jax
@@ -1103,6 +1129,22 @@ class SceneRegistry:
                     ),
                 }
                 jax.block_until_ready(fn(params, batch))
+                if prior_slots > 0:
+                    # Fresh leaves end to end: the plain call above DONATED
+                    # its batch on accelerators (R8 — never reuse a buffer
+                    # passed in a donated position).
+                    prior_batch = {
+                        "key": jax.random.split(jax.random.key(0), B),
+                        "image": jax.numpy.zeros(
+                            (B, entry.preset.height, entry.preset.width, 3)
+                        ),
+                        "prior_rvec": jax.numpy.zeros((B, prior_slots, 3)),
+                        "prior_tvec": jax.numpy.zeros((B, prior_slots, 3)),
+                        "prior_valid": jax.numpy.zeros(
+                            (B, prior_slots), bool
+                        ),
+                    }
+                    jax.block_until_ready(fn(params, prior_batch))
         return self.compile_cache_size()
 
     def dispatcher(self, cfg: RansacConfig = RansacConfig(),

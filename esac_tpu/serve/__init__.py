@@ -28,6 +28,13 @@ from esac_tpu.serve.loadgen import (
     run_open_loop,
     uniform_arrivals,
 )
+from esac_tpu.serve.session import (
+    SessionEvictedError,
+    SessionPolicy,
+    SessionRouter,
+    SessionTable,
+    SessionUnknownError,
+)
 from esac_tpu.serve.slo import (
     DeadlineExceededError,
     DispatcherClosedError,
@@ -49,6 +56,11 @@ __all__ = [
     "FaultInjector",
     "LaneQuarantinedError",
     "ServeError",
+    "SessionEvictedError",
+    "SessionPolicy",
+    "SessionRouter",
+    "SessionTable",
+    "SessionUnknownError",
     "ShedError",
     "SLOPolicy",
     "WorkerDiedError",

@@ -127,15 +127,16 @@ class _Request:
     caller should use (a bare ``event.wait()`` on a dead server is the
     exact unbounded-blocking bug this layer exists to kill)."""
 
-    __slots__ = ("frame", "scene", "route_k", "event", "result", "error",
-                 "t_submit", "t_done", "deadline", "done", "outcome",
+    __slots__ = ("frame", "scene", "route_k", "n_hyps", "event", "result",
+                 "error", "t_submit", "t_done", "deadline", "done", "outcome",
                  "owner", "spans", "trace")
 
     def __init__(self, frame, t_submit, scene=None, route_k=None,
-                 deadline=None, owner=None):
+                 deadline=None, owner=None, n_hyps=None):
         self.frame = frame
         self.scene = scene
         self.route_k = route_k
+        self.n_hyps = n_hyps
         self.event = threading.Event()
         self.result = None
         self.error = None
@@ -386,7 +387,8 @@ class MicroBatchDispatcher:
 
     def submit(self, frame: dict, scene=None, route_k=None,
                deadline_ms: float | None = None,
-               trace_ctx: Trace | None = None) -> _Request:
+               trace_ctx: Trace | None = None,
+               n_hyps: int | None = None) -> _Request:
         """Enqueue one frame tree (optionally for a registry ``scene`` and
         a routed top-K program ``route_k``); returns a request whose
         ``event`` fires when ``result`` (or ``error``) is set.
@@ -403,7 +405,15 @@ class MicroBatchDispatcher:
         tier up (FleetRouter sampling, ISSUE 15): the request gets a
         span chain and rides the registry fault path traced regardless
         of this dispatcher's own ``trace`` flag — the dispatcher stamps
-        the CHILD chain, the router owns the root and the store."""
+        the CHILD chain, the router owns the root and the store.
+
+        ``n_hyps`` rides the PR-8 per-dispatch hypothesis-budget override
+        into the registry serve fn (the session lane's shrunken-budget
+        knob, ISSUE 20).  An explicit ``n_hyps`` puts the request on its
+        own coalescing lane — ``(scene, route_k, n_hyps)`` — so requests
+        with different budgets (or different batch tree structures: the
+        session lane's frames carry prior-pose leaves) never share a
+        dispatch; outcome accounting stays keyed ``(scene, route_k)``."""
         t_submit = self._clock()
         if self._arrival_sink is not None and scene is not None:
             # Arrival tap for the prefetcher: outside the lock, before
@@ -417,9 +427,11 @@ class MicroBatchDispatcher:
             deadline_ms = self._slo.deadline_ms
         deadline = (t_submit + deadline_ms / 1e3
                     if deadline_ms is not None else None)
-        req = _Request(frame, t_submit, scene, route_k, deadline, owner=self)
+        req = _Request(frame, t_submit, scene, route_k, deadline, owner=self,
+                       n_hyps=n_hyps)
         self._init_trace(req, trace_ctx, t_submit, scene)
-        lane = (scene, route_k)
+        lane = (scene, route_k) if n_hyps is None \
+            else (scene, route_k, n_hyps)
         with self._work:
             if self._slo is None:
                 # Legacy backpressure — but a request WITH a deadline must
@@ -519,7 +531,8 @@ class MicroBatchDispatcher:
 
     def infer_one(self, frame: dict, scene=None, route_k=None,
                   timeout: float | None = None,
-                  deadline_ms: float | None = None) -> dict:
+                  deadline_ms: float | None = None,
+                  n_hyps: int | None = None) -> dict:
         """Blocking single-frame inference through the batching queue.
 
         ``timeout`` bounds the wait in seconds (independent of any SLO);
@@ -547,24 +560,27 @@ class MicroBatchDispatcher:
                       if deadline_ms is not None else [])
             bounds += [t_submit + timeout] if timeout is not None else []
             req = _Request(frame, t_submit, scene, route_k,
-                           min(bounds) if bounds else None, owner=self)
+                           min(bounds) if bounds else None, owner=self,
+                           n_hyps=n_hyps)
             self._init_trace(req, None, t_submit, scene)
+            lane = (scene, route_k) if n_hyps is None \
+                else (scene, route_k, n_hyps)
             with self._work:
                 self._raise_if_unservable()
                 self._count_offered()
                 # Same lock acquisition as the offered count: the request
                 # must never be observable in neither table (the invariant
                 # holds at every instant on the sync path too).
-                self._inflight = _Inflight(None, (scene, route_k), [req],
-                                           t_submit)
-            self._run([req], (scene, route_k), route_k, False, None)
+                self._inflight = _Inflight(None, lane, [req], t_submit)
+            self._run([req], lane, route_k, False, None)
         else:
             if deadline_ms is None and timeout is not None:
                 # The timeout is an end-to-end bound: riding it into the
                 # queue as the deadline bounds the space wait and queue
                 # residency too, not just the event wait at the end.
                 deadline_ms = timeout * 1e3
-            req = self.submit(frame, scene, route_k, deadline_ms)
+            req = self.submit(frame, scene, route_k, deadline_ms,
+                              n_hyps=n_hyps)
             limit = timeout
             if req.deadline is not None:
                 # Clamp to the REMAINING deadline window: submit() may
@@ -587,7 +603,7 @@ class MicroBatchDispatcher:
         return req.result
 
     def infer_many(self, frames: list[dict], scene=None,
-                   route_k=None) -> list[dict]:
+                   route_k=None, n_hyps=None) -> list[dict]:
         """Bulk inference: bucket-planned dispatches, staging double-buffered
         against in-flight compute.  Returns per-frame result trees (host
         numpy), in input order.  Bulk submission is inherently
@@ -618,7 +634,7 @@ class MicroBatchDispatcher:
         for i in range(len(bounds)):
             tree, n_valid, bucket = staged
             # async dispatch: compute starts
-            out = self._call(tree, scene, route_k)
+            out = self._call(tree, scene, route_k, n_hyps)
             if i + 1 < len(bounds):
                 staged = stage(*bounds[i + 1])  # host staging overlaps compute
             out = jax.block_until_ready(out)
@@ -648,11 +664,14 @@ class MicroBatchDispatcher:
 
     # ---------------- worker ----------------
 
-    def _call(self, tree, scene, route_k=None):
+    def _call(self, tree, scene, route_k=None, n_hyps=None):
         """Invoke the entry point: scene-carrying dispatches pass the scene
-        (and, for routed programs, ``route_k``) through — registry serve
-        fns take ``(tree, scene[, route_k])``; legacy traffic keeps the
+        (and, for routed programs, ``route_k``; for budget-override lanes,
+        ``n_hyps``) through — registry serve fns take
+        ``(tree, scene[, route_k[, n_hyps]])``; legacy traffic keeps the
         one-argument contract byte-for-byte."""
+        if n_hyps is not None:
+            return self._infer(tree, scene, route_k, n_hyps)
         if route_k is not None:
             return self._infer(tree, scene, route_k)
         if scene is None:
@@ -770,7 +789,7 @@ class MicroBatchDispatcher:
         overload the lane downshifts one rung of the degradation ladder
         (a cheaper static program from the SAME compiled family; never a
         recompile).  Returns (live requests, effective_k, degraded?)."""
-        scene, route_k = lane
+        scene, route_k = lane[0], lane[1]
         now = self._clock()
         live = []
         for r in batch:
@@ -907,7 +926,8 @@ class MicroBatchDispatcher:
         retry/quarantine handling.  ``gen`` is the worker generation (None
         on the sync path); a dispatch whose generation was abandoned by
         the watchdog discards its late outcome entirely."""
-        scene, route_k = lane
+        scene, route_k = lane[0], lane[1]
+        n_hyps = lane[2] if len(lane) > 2 else None
         self._stamp(reqs, "coalesced")
         # Trace context for the registry fault path (ISSUE 15): the
         # batch's traces ride a contextvar through the dispatch so the
@@ -927,10 +947,10 @@ class MicroBatchDispatcher:
                 if traced:
                     with trace_scope(traced):
                         host, bucket, n_valid, t_done = self._dispatch(
-                            reqs, scene, eff_k)
+                            reqs, scene, eff_k, n_hyps)
                 else:
                     host, bucket, n_valid, t_done = self._dispatch(
-                        reqs, scene, eff_k)
+                        reqs, scene, eff_k, n_hyps)
                 # Host-side result slicing: inside the try — a malformed
                 # result tree must fail THIS batch, never the worker — but
                 # OUTSIDE the lock: admission control's microsecond-
@@ -1028,7 +1048,7 @@ class MicroBatchDispatcher:
                                         n=n_ok)
             return
 
-    def _dispatch(self, reqs: list[_Request], scene, route_k):
+    def _dispatch(self, reqs: list[_Request], scene, route_k, n_hyps=None):
         """Pad, stage and execute one dispatch; returns the host-side
         result tree + timing.  No dispatcher state is touched here — the
         caller owns locking and fan-out.  The span stamps reuse the
@@ -1044,7 +1064,7 @@ class MicroBatchDispatcher:
         )
         staged = jax.device_put(padded)
         self._stamp(reqs, "staged")
-        out = self._call(staged, scene, route_k)
+        out = self._call(staged, scene, route_k, n_hyps)
         self._stamp(reqs, "dispatched")
         out = jax.block_until_ready(out)
         t_done = self._clock()
@@ -1212,7 +1232,7 @@ class MicroBatchDispatcher:
         with self._lock:
             return dict(self._quarantined)
 
-    def release_lane(self, scene=None, route_k=None) -> bool:
+    def release_lane(self, scene=None, route_k=None, n_hyps=None) -> bool:
         """Operator action: clear a lane's quarantine + failure streak
         after the underlying fault (relay recovery, fixed weights) is
         resolved.  New submissions to the lane are admitted again.
@@ -1222,7 +1242,8 @@ class MicroBatchDispatcher:
         orders leave a consistent breaker state and exact accounting
         (pinned in tests/test_serve_slo.py).  True when a quarantine
         was actually cleared."""
-        lane = (scene, route_k)
+        lane = (scene, route_k) if n_hyps is None \
+            else (scene, route_k, n_hyps)
         with self._work:
             was = self._quarantined.pop(lane, None)
             self._fail_streak.pop(lane, None)

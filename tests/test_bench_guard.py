@@ -810,11 +810,11 @@ def test_all_mode_mains_share_the_wedge_safe_scaffold(monkeypatch):
                  bench._scoring_main, bench._chaos_main,
                  bench._obs_main, bench._prefetch_main,
                  bench._fleet_main, bench._hostpath_main,
-                 bench._city_main):
+                 bench._city_main, bench._sessions_main):
         main([], [0.0, 0.0, 0.0])
     assert [c[0] for c in calls] == [
         "serve", "registry", "routed", "loadtest", "scoring", "chaos",
-        "obs", "prefetch", "fleet", "hostpath", "city",
+        "obs", "prefetch", "fleet", "hostpath", "city", "sessions",
     ]
 
 
@@ -1740,3 +1740,187 @@ def test_city_artifact_schema_committed():
     assert ft["violations"] == []
     assert ft["committed_errors"] >= 15
     assert city["gc"]["frozen"] is True
+
+
+# ---------------- sessions driver contract (ISSUE 20) ----------------
+
+def _canned_sessions():
+    """Minimal-but-complete sessions payload: the schema the driver and
+    the committed .session_serve.json artifact rely on."""
+    def point(s, served, shed):
+        n = served + shed
+        return {
+            "sessions": s, "frames_per_session": 16, "offered": n,
+            "outcomes": {"served": served, "session_evicted": shed},
+            "sums_to_offered": True, "wall_s": 1.0,
+            "frames_per_s": float(n), "tracked_frac": 0.9,
+            "track_entries": s, "budget_saved_hyps": 100 * s,
+            "session_collector_rendered": True, "compiled_programs": 8,
+        }
+
+    return {
+        "prior_slots": 4,
+        "scene": {"hw": [24, 24], "num_experts": 2, "full_n_hyps": 64,
+                  "track_n_hyps": 8},
+        "parity": {
+            "prewarm_compiled_programs": 8,
+            "entry": {
+                "dense": {"bitwise_equal": True, "prior_hit_any": False},
+                "routed_k2": {"bitwise_equal": True,
+                              "prior_hit_any": False},
+            },
+            "dispatcher_bitwise": True,
+            "transitions": ["tracked", "lost", "tracked", "lost"],
+            "tracked_dispatches": [False, True, False, True],
+            "track_losses": 2,
+            "recovery_full_budget_next_frame": True,
+            "hot_path_recompiles": 0,
+            "recompiles_during_flap": 0,
+            "typed_errors": {
+                "unknown": {"error": "SessionUnknownError",
+                            "wire_name": "session_unknown",
+                            "retryable": False},
+                "evicted": {"error": "SessionEvictedError",
+                            "wire_name": "session_evicted",
+                            "retryable": True, "is_shed": True},
+            },
+            "track_loss_trace_events": 2,
+        },
+        "sequence": {
+            "frames": 48, "tracked_frames": 46, "tracked_frac": 0.958,
+            "tracked_speedup_x": 2.5, "full_ms_median": 6.0,
+            "tracked_ms_median": 2.4, "accuracy_matched": True,
+            "prior_hit_frac_tracked": 0.8, "budget_saved_hyps": 10000,
+        },
+        "recovery": {"corrupted_frame": 24,
+                     "loss_transition_at_corruption": True,
+                     "fallback_full_budget_next_frame": True,
+                     "recovered_within_one_frame": True},
+        "loadtest": {"points": [point(2, 32, 0), point(4, 60, 4)],
+                     "hot_path_recompiles": 0},
+        "lock_witness": {"committed_graph_present": True,
+                         "violations": [],
+                         "observed_subgraph_of_committed": True,
+                         "session_lock_observed": True},
+        "fault_taxonomy": {"observed": {"SessionEvictedError->shed": 1},
+                           "violations": []},
+        "note": "canned",
+    }
+
+
+def test_sessions_main_emits_one_json_line_and_artifact(tmp_path,
+                                                        monkeypatch,
+                                                        capsys):
+    """The driver contract: ONE parseable JSON line on stdout, headline
+    = the tracked-vs-full sequence speedup with the parity/recompile/
+    recovery acceptance fields surfaced, and the .session_serve.json
+    artifact with platform + recorded_at."""
+    monkeypatch.setattr(bench, "_SESSIONS_FILE", tmp_path / "sessions.json")
+    monkeypatch.setattr(
+        bench, "measure_on_device",
+        lambda *a, **k: {"sessions": _canned_sessions(), "platform": "tpu",
+                         "device_kind": "fake-tpu"},
+    )
+    bench._sessions_main([], [0.0, 0.0, 0.0])
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1, f"expected ONE JSON line, got {len(lines)}"
+    out = json.loads(lines[0])
+    assert out["metric"] == "session_tracked_speedup_x"
+    assert out["value"] == 2.5
+    assert out["unit"] == "x"
+    assert "vs_baseline" in out
+    assert out["parity_bitwise_entry"] is True
+    assert out["parity_bitwise_dispatcher"] is True
+    assert out["hot_path_recompiles"] == 0
+    assert out["recovered_within_one_frame"] is True
+    assert out["accounting_exact"] is True
+    artifact = json.loads((tmp_path / "sessions.json").read_text())
+    assert artifact["platform"] == "tpu"
+    assert "recorded_at" in artifact
+    assert artifact["sessions"]["prior_slots"] == 4
+
+
+def test_sessions_cpu_fallback_carries_provenance(tmp_path, monkeypatch,
+                                                  capsys):
+    """Relay wedged -> the session drill measures on CPU and SAYS so."""
+    monkeypatch.setattr(bench, "_SESSIONS_FILE", tmp_path / "sessions.json")
+    monkeypatch.setattr(bench, "measure_on_device", lambda *a, **k: None)
+    monkeypatch.setattr(bench, "_measure_sessions",
+                        lambda *a, **k: _canned_sessions())
+    bench._sessions_main([], [0.0, 0.0, 0.0])
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1
+    out = json.loads(lines[0])
+    assert "CPU" in out["note"] or "cpu" in out["note"]
+    artifact = json.loads((tmp_path / "sessions.json").read_text())
+    assert artifact["platform"] == "cpu"
+    assert artifact["note"] == out["note"]
+
+
+def test_sessions_artifact_schema_committed():
+    """The committed .session_serve.json (when present) satisfies the
+    ISSUE 20 acceptance schema: all-invalid parity bitwise at entry
+    level (dense AND routed) and through a live dispatcher, zero
+    hot-path recompiles across tracked/lost/recovered transitions AND
+    across the session loadtest, >= 2x tracked sequence speedup at
+    matched pose accuracy, recovery-after-loss within one frame with
+    the loss typed + accounted, per-point session outcome classes
+    summing exactly to offered, and the lock/fault witnesses
+    violation-free."""
+    import pathlib
+
+    path = pathlib.Path(bench.__file__).parent / ".session_serve.json"
+    if not path.exists():
+        import pytest
+
+        pytest.skip("no committed sessions artifact yet")
+    artifact = json.loads(path.read_text())
+    for key in ("metric", "value", "unit", "platform", "recorded_at",
+                "sessions"):
+        assert key in artifact, key
+    sess = artifact["sessions"]
+    par = sess["parity"]
+    # The §23 parity pin, entry level (dense AND routed) + dispatcher.
+    for leg in par["entry"].values():
+        assert leg["bitwise_equal"] is True
+        assert leg["prior_hit_any"] is False
+    assert set(par["entry"]) >= {"dense"}
+    assert any(k.startswith("routed") for k in par["entry"])
+    assert par["dispatcher_bitwise"] is True
+    # Zero hot-path recompiles, flap drill and loadtest both.
+    assert par["hot_path_recompiles"] == 0
+    assert par["recompiles_during_flap"] == 0
+    assert sess["loadtest"]["hot_path_recompiles"] == 0
+    # Every loss was followed by a full-budget recovery dispatch.
+    assert par["recovery_full_budget_next_frame"] is True
+    assert par["track_losses"] >= 1
+    # Typed session errors observed with their committed wire names.
+    te = par["typed_errors"]
+    assert te["evicted"]["wire_name"] == "session_evicted"
+    assert te["evicted"]["retryable"] is True
+    assert te["evicted"]["is_shed"] is True
+    assert te["unknown"]["wire_name"] == "session_unknown"
+    assert te["unknown"]["retryable"] is False
+    # The perf acceptance: >= 2x tracked speedup at matched accuracy.
+    seq = sess["sequence"]
+    assert seq["tracked_speedup_x"] >= 2.0
+    assert seq["accuracy_matched"] is True
+    assert seq["tracked_frames"] >= seq["frames"] // 2
+    assert 0.0 < seq["prior_hit_frac_tracked"] <= 1.0
+    assert seq["budget_saved_hyps"] > 0
+    # Recovery-after-loss within one frame, typed + accounted.
+    rec = sess["recovery"]
+    assert rec["loss_transition_at_corruption"] is True
+    assert rec["fallback_full_budget_next_frame"] is True
+    assert rec["recovered_within_one_frame"] is True
+    # Session-level loadtest: exact outcome accounting per point.
+    for p in sess["loadtest"]["points"]:
+        assert sum(p["outcomes"].values()) == p["offered"]
+        assert p["sums_to_offered"] is True
+        assert p["session_collector_rendered"] is True
+    # Runtime witnesses, violation-free against the committed graphs.
+    lw = sess["lock_witness"]
+    assert lw["committed_graph_present"] is True
+    assert lw["violations"] == []
+    assert lw["session_lock_observed"] is True
+    assert sess["fault_taxonomy"]["violations"] == []

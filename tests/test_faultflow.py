@@ -675,12 +675,14 @@ def test_repo_matches_runtime_contract():
     import esac_tpu.fleet.router as router
     import esac_tpu.registry.health as health
     import esac_tpu.registry.manifest as manifest
+    import esac_tpu.serve.session as session
     import esac_tpu.serve.slo as slo
 
     tax = load_taxonomy(REPO / FAULT_TAXONOMY_NAME)
     for name, rec in tax["errors"].items():
         cls = getattr(slo, name, None) or getattr(manifest, name, None) \
-            or getattr(health, name, None) or getattr(router, name, None)
+            or getattr(health, name, None) or getattr(router, name, None) \
+            or getattr(session, name, None)
         assert cls is not None, name
         assert cls.retryable is rec["retryable"], name
         assert cls.wire_name == rec["wire_name"], name

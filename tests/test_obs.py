@@ -1024,6 +1024,10 @@ def test_every_registered_collector_is_known_and_renders():
     # never invoked, so a stub keeps jax out of this test).
     router.attach_retrieval(RetrievalFront(
         lambda *a: None, None, SceneIndex(capacity=4, embed_dim=4)))
+    # ISSUE 20: the session lane registers the "session" collector.
+    from esac_tpu.serve import SessionRouter
+
+    SessionRouter(disp)
     snap = disp.obs.snapshot()
     registered = set(snap["collectors"])
     unknown = registered - set(KNOWN_COLLECTORS)

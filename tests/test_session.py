@@ -1,0 +1,459 @@
+"""Temporal-session serving lane tests (ISSUE 20 acceptance).
+
+The load-bearing claims:
+
+- **all-invalid parity, through a live dispatcher**: a session frame
+  whose prior mask is all-invalid (cold / never-tracked) rides the
+  prior-slot program at the scene's full budget and reproduces the
+  plain dispatch BIT-FOR-BIT — the prior slot is free until a prior
+  actually wins (DESIGN.md §23; the entry-level pin lives in
+  ``test_esac.py``-style direct calls below);
+- **zero hot-path recompiles**: with the prior ladder prewarmed
+  (``SceneRegistry.prewarm_programs(prior_slots=...)``), a session
+  flapping tracked → lost → recovered never compiles a new program —
+  the validity mask and the ``n_hyps`` lane carry every transition;
+- **typed session errors**: an evicted session raises the retryable
+  ``SessionEvictedError`` (a shed: admission said no), a never-opened
+  or closed id the non-retryable ``SessionUnknownError``, and the
+  observed (error, outcome) pairs stay inside the committed
+  ``.fault_taxonomy.json``;
+- **leaf lock**: ``SessionTable._lock`` is a committed LEAF of
+  ``.lock_graph.json`` — the runtime witness must observe no edge out
+  of it even under concurrent session traffic;
+- **fleet affinity + budget passthrough**: a session over a
+  ``FleetRouter`` keeps its scene's replica affinity and its tracked
+  frames dispatch at the shrunken ``n_hyps`` override.
+"""
+
+import dataclasses
+import pathlib
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from esac_tpu.models import ExpertNet, GatingNet
+from esac_tpu.ransac import RansacConfig, esac_infer, esac_infer_prior
+from esac_tpu.registry import (
+    SceneEntry,
+    SceneManifest,
+    ScenePreset,
+    SceneRegistry,
+)
+from esac_tpu.serve import (
+    MicroBatchDispatcher,
+    SessionEvictedError,
+    SessionPolicy,
+    SessionRouter,
+    SessionTable,
+    SessionUnknownError,
+    ShedError,
+    SLOPolicy,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+H = W = 16
+M = 2
+FULL_HYPS = 8
+TRACK_HYPS = 4
+P = 3
+PRESET = ScenePreset(
+    height=H, width=W, num_experts=M,
+    stem_channels=(2, 2, 2), head_channels=2, head_depth=1,
+    gating_channels=(2,), compute_dtype="float32", gated=True,
+)
+CFG = RansacConfig(n_hyps=FULL_HYPS, refine_iters=2, polish_iters=1,
+                   frame_buckets=(1,), serve_max_wait_ms=0.0,
+                   serve_queue_depth=64)
+POSE_KEYS = ("rvec", "tvec", "expert", "inlier_frac", "gating_probs")
+
+
+def _params(seed=0):
+    expert = ExpertNet(
+        scene_center=(0.0, 0.0, 0.0), stem_channels=PRESET.stem_channels,
+        head_channels=PRESET.head_channels, head_depth=PRESET.head_depth,
+        compute_dtype=jnp.float32,
+    )
+    gating = GatingNet(num_experts=M, channels=PRESET.gating_channels,
+                       compute_dtype=jnp.float32)
+    img0 = jnp.zeros((1, H, W, 3))
+    return {
+        "expert": jax.vmap(lambda k: expert.init(k, img0))(
+            jax.random.split(jax.random.key(seed), M)
+        ),
+        "gating": gating.init(jax.random.key(seed + 100), img0),
+        "centers": jnp.asarray(
+            np.asarray([[0.0, 0.0, 2.0]], np.float32)
+            + np.arange(M, dtype=np.float32)[:, None] * 0.1
+        ),
+        "c": jnp.asarray([W / 2.0, H / 2.0]),
+        "f": jnp.float32(20.0),
+    }
+
+
+@pytest.fixture(scope="module")
+def registry():
+    params = {"a": _params(0)}
+    m = SceneManifest()
+    m.add(SceneEntry(
+        scene_id="a", version=1, expert_ckpt="unused",
+        gating_ckpt="unused", preset=PRESET, ransac=CFG,
+    ))
+    return SceneRegistry(m, loader=lambda e: params[e.scene_id])
+
+
+def _frame(i):
+    return {
+        "key": jax.random.fold_in(jax.random.key(7), i),
+        "image": np.asarray(jax.random.uniform(
+            jax.random.fold_in(jax.random.key(42), i), (H, W, 3)
+        )),
+    }
+
+
+def _bitwise(a, b, keys=POSE_KEYS):
+    return all(
+        np.array_equal(np.asarray(a[k]), np.asarray(b[k])) for k in keys
+    )
+
+
+# ---------------- policy / table host logic ----------------
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        SessionPolicy(prior_slots=0)
+    with pytest.raises(ValueError):
+        SessionPolicy(track_n_hyps=0)
+    with pytest.raises(ValueError):
+        SessionPolicy(track_loss_frac=0.0)
+    with pytest.raises(ValueError):
+        SessionPolicy(track_loss_frac=1.0)
+    with pytest.raises(ValueError):
+        SessionPolicy(track_enter_frac=1.5)
+    with pytest.raises(ValueError):
+        SessionPolicy(max_sessions=0)
+    # enter bar defaults to the loss bar; explicit hysteresis sticks.
+    assert SessionPolicy(track_loss_frac=0.2).enter_frac == 0.2
+    assert SessionPolicy(track_loss_frac=0.2,
+                         track_enter_frac=0.4).enter_frac == 0.4
+
+
+def test_table_transitions_and_motion_priors():
+    """cold -> tracked -> lost walks the documented transition machine;
+    the tracked plan carries (last winner, constant-velocity
+    extrapolation) in slots 0/1 and clears ALL motion state on loss."""
+    pol = SessionPolicy(prior_slots=P, track_n_hyps=TRACK_HYPS,
+                        track_loss_frac=0.3, track_enter_frac=0.5)
+    t = SessionTable(pol)
+    t.open("s", scene="a", full_n_hyps=FULL_HYPS)
+
+    scene, rk, n_hyps, rv, tv, valid, tracked = t.plan("s")
+    assert (scene, rk, n_hyps, tracked) == ("a", None, FULL_HYPS, False)
+    assert not valid.any()
+
+    # Full-budget winner below the enter bar: still cold.
+    assert t.observe("s", np.ones(3), np.ones(3), 0.4, False) == "cold"
+    assert t.plan("s")[6] is False
+    # At the bar: enters tracking; slot 0 = the winner, slot 1 the
+    # constant-velocity extrapolation (the cold frame's winner counts
+    # as the previous pose — a full-budget winner is still a winner).
+    r1, t1 = np.asarray([0.1, 0.0, 0.0]), np.asarray([1.0, 0.0, 0.0])
+    assert t.observe("s", r1, t1, 0.6, False) == "tracked"
+    _, _, n_hyps, rv, tv, valid, tracked = t.plan("s")
+    assert tracked and n_hyps == TRACK_HYPS
+    assert valid.tolist() == [True, True, False]
+    np.testing.assert_array_equal(rv[0], r1.astype(np.float32))
+    np.testing.assert_allclose(rv[1], 2.0 * r1 - np.ones(3), rtol=1e-6)
+    # Second winner: slot 1 is the constant-velocity extrapolation
+    # 2*last - prev, linear in the rvec/tvec coordinates.
+    r2, t2 = np.asarray([0.2, 0.0, 0.0]), np.asarray([1.5, 0.0, 0.0])
+    assert t.observe("s", r2, t2, 0.7, True) == "tracked"
+    _, _, _, rv, tv, valid, _ = t.plan("s")
+    assert valid.tolist() == [True, True, False]
+    np.testing.assert_allclose(rv[1], 2.0 * r2 - r1, rtol=1e-6)
+    np.testing.assert_allclose(tv[1], 2.0 * t2 - t1, rtol=1e-6)
+
+    # Tracked winner under the loss bar: lost, motion state cleared,
+    # NEXT frame plans the full budget with no priors.
+    assert t.observe("s", r2, t2, 0.1, True) == "lost"
+    _, _, n_hyps, _, _, valid, tracked = t.plan("s")
+    assert not tracked and n_hyps == FULL_HYPS and not valid.any()
+
+    s = t.stats()
+    assert s["frames"] == 4 and s["tracked_frames"] == 2
+    assert s["track_losses"] == 1 and s["track_entries"] == 1
+    assert s["budget_saved_hyps"] == 2 * (FULL_HYPS - TRACK_HYPS)
+
+
+def test_table_eviction_and_unknown_are_typed():
+    pol = SessionPolicy(max_sessions=1)
+    t = SessionTable(pol)
+    t.open("a")
+    t.open("b")  # evicts "a" (LRU, capacity 1)
+    with pytest.raises(SessionEvictedError) as ei:
+        t.plan("a")
+    assert isinstance(ei.value, ShedError)
+    assert ei.value.retryable and ei.value.wire_name == "session_evicted"
+    with pytest.raises(SessionUnknownError) as ui:
+        t.plan("never-opened")
+    assert not ui.value.retryable
+    assert ui.value.wire_name == "session_unknown"
+    # close() is the caller's own action -> unknown, not evicted.
+    assert t.close("b")
+    with pytest.raises(SessionUnknownError):
+        t.plan("b")
+    # A winner landing after eviction is a no-op, not a crash.
+    assert t.observe("a", np.zeros(3), np.zeros(3), 0.9, False) == "evicted"
+    # Re-opening an evicted id resumes cold.
+    t.open("a")
+    assert t.plan("a")[6] is False
+    # The observed pair is a committed .fault_taxonomy.json edge.
+    from esac_tpu.lint.witness import OutcomeWitness
+
+    ow = OutcomeWitness.from_repo(REPO)
+    ow.observe("SessionEvictedError", "shed")
+    ow.assert_consistent()
+
+
+# ---------------- entry-level parity (the §23 pin) ----------------
+
+def test_prior_entry_all_invalid_is_bitwise_dense():
+    frame = _frame(0)
+    pixels = jnp.stack(jnp.meshgrid(
+        jnp.arange(2.0, W, 4.0), jnp.arange(2.0, H, 4.0)
+    ), -1).reshape(-1, 2)
+    coords = jax.random.normal(jax.random.key(3), (M, pixels.shape[0], 3))
+    f, c = jnp.float32(20.0), jnp.asarray([W / 2.0, H / 2.0])
+    cfg = RansacConfig(n_hyps=FULL_HYPS, refine_iters=2, polish_iters=1)
+    plain = esac_infer(jax.random.key(5), jnp.zeros(M), coords, pixels,
+                       f, c, cfg)
+    prior = esac_infer_prior(
+        jax.random.key(5), jnp.zeros(M), coords, pixels, f, c,
+        jnp.zeros((P, 3)), jnp.zeros((P, 3)), jnp.zeros((P,), bool), cfg,
+    )
+    assert not bool(prior["prior_hit"])
+    assert int(prior["prior_slot"]) == P  # sentinel: sampled stream won
+    keys = [k for k in ("rvec", "tvec", "expert", "inlier_frac", "score",
+                        "scores") if k in plain and k in prior]
+    assert {"rvec", "tvec", "expert", "inlier_frac"} <= set(keys)
+    for k in keys:
+        assert np.array_equal(np.asarray(prior[k]), np.asarray(plain[k])), k
+
+
+def test_prior_entry_valid_prior_can_win():
+    """A valid prior equal to a near-perfect pose beats the sampled
+    stream on a frame whose coords support it — the slot is live, not
+    decorative."""
+    from esac_tpu.geometry import backproject_at_depth, rodrigues
+
+    rvec = jnp.asarray([0.1, -0.2, 0.05])
+    tvec = jnp.asarray([0.0, 0.1, 2.0])
+    pixels = jnp.stack(jnp.meshgrid(
+        jnp.arange(2.0, W, 4.0), jnp.arange(2.0, H, 4.0)
+    ), -1).reshape(-1, 2)
+    f, c = jnp.float32(20.0), jnp.asarray([W / 2.0, H / 2.0])
+    # Coords consistent with (rvec, tvec) at depth 2 plus enough noise
+    # that the sampled minimal solves are imperfect while the injected
+    # prior IS the noise-free pose — the prior must score strictly best.
+    world = backproject_at_depth(rodrigues(rvec), tvec, pixels, f, c, 2.0)
+    world = world + 0.05 * jax.random.normal(jax.random.key(8), world.shape)
+    coords = jnp.stack([world, world + 0.5])  # expert 1 is junk
+    prv = jnp.zeros((P, 3)).at[1].set(rvec)
+    ptv = jnp.zeros((P, 3)).at[1].set(tvec)
+    pvalid = jnp.zeros((P,), bool).at[1].set(True)
+    cfg = RansacConfig(n_hyps=4, refine_iters=2, polish_iters=1)
+    out = esac_infer_prior(jax.random.key(1), jnp.zeros(M), coords, pixels,
+                           f, c, prv, ptv, pvalid, cfg)
+    assert bool(out["prior_hit"])
+    assert int(out["prior_slot"]) == 1
+    assert int(out["expert"]) == 0
+
+
+# ---------------- dispatcher-level parity + zero recompiles ----------------
+
+def test_session_lane_parity_and_zero_recompiles(registry):
+    """The tentpole acceptance: through a LIVE worker-backed dispatcher,
+    a cold session frame is bitwise the plain dispatch, and a session
+    flapping tracked -> lost -> recovered compiles nothing beyond the
+    prewarmed ladder."""
+    reg = registry
+    compiled = reg.prewarm_programs(
+        "a", frame_buckets=(1,), route_ks=(None,),
+        n_hyps_overrides=(None, TRACK_HYPS), prior_slots=P,
+    )
+    pol = SessionPolicy(prior_slots=P, track_n_hyps=TRACK_HYPS,
+                        track_loss_frac=0.999, track_enter_frac=0.5)
+    disp = reg.dispatcher(CFG, slo=SLOPolicy(watchdog_ms=60_000.0))
+    try:
+        router = SessionRouter(disp, pol)
+        router.open("s", scene="a", full_n_hyps=FULL_HYPS)
+
+        plain = disp.infer_one(_frame(0), scene="a", timeout=30.0)
+        via_session = router.infer_frame("s", _frame(0), timeout=30.0)
+        assert via_session["session_tracked"] is False
+        assert _bitwise(via_session, plain)
+
+        # Seed tracking deterministically, then flap: the tracked frame
+        # (loss bar 0.999) drops the track, the recovery frame runs the
+        # full budget, re-enters if the winner clears the bar.
+        router.table.observe("s", np.zeros(3, np.float32),
+                             np.zeros(3, np.float32), 1.0 - 1e-6, False)
+        before = reg.compile_cache_size()
+        transitions, tracked = [], []
+        for i in range(6):
+            out = router.infer_frame("s", _frame(i), timeout=30.0)
+            transitions.append(out["session_transition"])
+            tracked.append(out["session_tracked"])
+        assert tracked[0] is True          # seeded -> tracked lane
+        assert transitions[0] == "lost"    # bar 0.999 unreachable
+        assert tracked[1] is False         # recovery = full budget
+        assert reg.compile_cache_size() == before == compiled
+        assert router.table.stats()["track_losses"] >= 1
+    finally:
+        disp.close()
+
+
+def test_session_lock_is_leaf_under_concurrent_traffic(registry):
+    """Runtime lock witness: concurrent sessions through a live
+    dispatcher observe NO edge out of SessionTable._lock, and the whole
+    observed order stays inside the committed .lock_graph.json."""
+    from esac_tpu.lint.lockgraph import LOCK_GRAPH_NAME, load_graph
+    from esac_tpu.lint.witness import LockWitness
+
+    reg = registry
+    disp = reg.dispatcher(CFG, slo=SLOPolicy(watchdog_ms=60_000.0),
+                          start_worker=False)
+    witness = LockWitness()
+    router = SessionRouter(disp, SessionPolicy(
+        prior_slots=P, track_n_hyps=TRACK_HYPS, track_loss_frac=1e-6,
+        track_enter_frac=0.5,
+    ))
+    witness.attach_fleet(disp=disp, session_router=router)
+    disp.start()
+    try:
+        errors = []
+
+        def stream(sid):
+            try:
+                router.open(sid, scene="a", full_n_hyps=FULL_HYPS)
+                for i in range(4):
+                    router.infer_frame(sid, _frame(i), timeout=30.0)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=stream, args=(f"s{t}",))
+                   for t in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+        assert errors == []
+    finally:
+        disp.close()
+
+    committed = load_graph(REPO / LOCK_GRAPH_NAME)
+    assert committed is not None
+    assert "SessionTable._lock" in committed.get("nodes", {})
+    witness.assert_subgraph(committed)
+    holds = witness.snapshot()["holds"]
+    assert any("SessionTable._lock" in str(k) for k in holds)
+    assert not any(src.startswith("SessionTable._lock")
+                   for (src, _dst) in witness.edges())
+
+
+def test_track_loss_event_rides_sampled_trace(registry):
+    """A track loss on a traced request lands as a session:track_loss
+    event span on the §19 causal trace."""
+    reg = registry
+    disp = reg.dispatcher(CFG, slo=SLOPolicy(watchdog_ms=60_000.0),
+                          trace=True)
+    try:
+        router = SessionRouter(disp, SessionPolicy(
+            prior_slots=P, track_n_hyps=TRACK_HYPS,
+            track_loss_frac=0.999, track_enter_frac=0.5,
+        ))
+        router.open("s", scene="a", full_n_hyps=FULL_HYPS)
+        router.table.observe("s", np.zeros(3, np.float32),
+                             np.zeros(3, np.float32), 0.9, False)
+        out = router.infer_frame("s", _frame(0), timeout=30.0)
+        assert out["session_transition"] == "lost"
+        events = [
+            s for t in disp._trace_store.traces()
+            for s in list(t.spans) if s.name == "session:track_loss"
+        ]
+        assert len(events) == 1
+        assert events[0].annotations["session"] == "s"
+    finally:
+        disp.close()
+
+
+# ---------------- obs collector ----------------
+
+def test_session_collector_in_unified_snapshot(registry):
+    disp = registry.dispatcher(CFG, start_worker=False)
+    router = SessionRouter(disp, SessionPolicy())
+    router.open("x")
+    snap = disp.obs.snapshot()
+    sess = snap["collectors"]["session"]
+    assert sess["sessions"] == 1 and sess["opened"] == 1
+    assert router.close("x")
+    assert disp.obs.snapshot()["collectors"]["session"]["closed"] == 1
+    disp.close()
+
+
+# ---------------- fleet affinity + budget passthrough ----------------
+
+def test_fleet_affinity_and_tracked_budget_passthrough():
+    """Over a FleetRouter, a session's frames keep their scene's replica
+    affinity and tracked frames carry the shrunken n_hyps override."""
+    from esac_tpu.fleet import FleetPolicy, FleetRouter, Replica
+
+    cfg = RansacConfig(n_hyps=FULL_HYPS, refine_iters=2, frame_buckets=(1,),
+                       serve_max_wait_ms=0.0, serve_queue_depth=64)
+    seen = []  # (replica, n_hyps) per dispatch
+    mu = threading.Lock()
+
+    def infer(idx):
+        def fn(tree, scene=None, route_k=None, n_hyps=None):
+            lanes = tree["x"].shape[0]
+            with mu:
+                seen.append((idx, n_hyps))
+            return {
+                "rvec": np.zeros((lanes, 3), np.float32),
+                "tvec": np.zeros((lanes, 3), np.float32),
+                "inlier_frac": np.full(lanes, 0.9, np.float32),
+                "rep": np.full(lanes, idx, np.int32),
+            }
+        return fn
+
+    slo = SLOPolicy(watchdog_ms=60_000.0)
+    reps = [Replica(f"r{i}", MicroBatchDispatcher(infer(i), cfg, slo=slo))
+            for i in range(2)]
+    router = FleetRouter(reps, FleetPolicy(poll_ms=2.0))
+    try:
+        sess = SessionRouter(router, SessionPolicy(
+            prior_slots=P, track_n_hyps=TRACK_HYPS,
+            track_loss_frac=0.1, track_enter_frac=0.5,
+        ))
+        sess.open("s", scene="sc", full_n_hyps=FULL_HYPS)
+        homes = set()
+        for i in range(5):
+            out = sess.infer_frame(
+                "s", {"x": np.full(2, float(i), np.float32)}, timeout=30.0
+            )
+            homes.add(int(np.asarray(out["rep"])))
+            assert out["session_tracked"] is (i > 0)
+        # One home replica end to end (scene affinity unbroken by the
+        # shrunken-budget lane), and the budget ladder: full first
+        # frame, tracked override after.
+        assert len(homes) == 1
+        budgets = [h for _r, h in seen]
+        assert budgets[0] == FULL_HYPS
+        assert set(budgets[1:]) == {TRACK_HYPS}
+        stats = router.affinity_stats()
+        assert stats["affinity"] >= 4
+    finally:
+        router.close()
